@@ -1,0 +1,56 @@
+"""consensusclustr_tpu — TPU-native consensus clustering for scRNA-seq.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the R package
+AndyCGraham/consensusClustR (reference: /root/reference/R/consensusClust.R):
+iterative, bootstrapped consensus clustering of single-cell count matrices with
+statistical significance testing against a negative-binomial + Gaussian-copula
+null model.
+
+Design stance (not a port): the reference's per-process R closures and
+runtime-compiled C++ callbacks become fixed-shape batched array programs:
+
+  * the (bootstrap, k, resolution) sweep is one vmapped grid of a pure, jitted
+    kernel ``(key, pca, params) -> (labels, score)``;
+  * the O(n^2 * nboots) co-clustering Jaccard distance is a single tiled
+    MXU pass (one-hot einsum / Pallas kernel), accumulated across device
+    shards with psum;
+  * per-gene statistics (deviance HVG, NB MLE) are vmapped reductions;
+  * host Python drives only irregular control flow (recursion, dendrogram
+    walking, merge loops over tiny cluster-count matrices).
+
+Public API mirrors the reference's four exports
+(reference NAMESPACE:3-6): ``consensus_clust``, ``get_clust_assignments``,
+``test_splits``, ``determine_hierarchy``.
+"""
+
+from consensusclustr_tpu.config import ClusterConfig, DEFAULT_RES_RANGE
+
+__version__ = "0.1.0"
+
+# Lazy top-level exports (PEP 562): keeps `import consensusclustr_tpu.prep`
+# cheap and avoids importing the full pipeline for kernel-level use.
+_LAZY = {
+    "consensus_clust": ("consensusclustr_tpu.api", "consensus_clust"),
+    "get_clust_assignments": ("consensusclustr_tpu.cluster.engine", "get_clust_assignments"),
+    "determine_hierarchy": ("consensusclustr_tpu.hierarchy.dendro", "determine_hierarchy"),
+    "test_splits": ("consensusclustr_tpu.nulltest.splits", "test_splits"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'consensusclustr_tpu' has no attribute {name!r}")
+
+__all__ = [
+    "ClusterConfig",
+    "DEFAULT_RES_RANGE",
+    "consensus_clust",
+    "get_clust_assignments",
+    "determine_hierarchy",
+    "test_splits",
+    "__version__",
+]

@@ -1,0 +1,123 @@
+"""Configuration schema mirroring the reference's 34 function parameters.
+
+The reference has no config files — its de-facto config schema is the default
+argument list of ``consensusClust`` (reference R/consensusClust.R:122-128) and
+``testSplits`` (:892), validated by ~20 stopifnot contracts (:130-191).
+``ClusterConfig`` mirrors those names/defaults 1:1 (snake_cased), plus a small
+set of TPU-specific static-shape knobs that have no reference counterpart.
+
+Deliberate deviations from reference bugs (see docs/quirks.md):
+  * ``seed`` is honored everywhere (reference hardcodes set.seed(123) at :194).
+  * ``scale`` gates scaling of the PCA input (reference gates it on ``center``
+    at :339/:369).
+  * "any cluster < 50 cells" triggers the significance gate (reference's :521
+    expression is only truthy when *all* clusters are small).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+
+def _default_res_range() -> tuple:
+    # reference R/consensusClust.R:126: c(seq(0.01, 0.3, length.out = 10),
+    #                                     seq(0.25, 1.5, length.out = 10))
+    lo = np.linspace(0.01, 0.3, 10)
+    hi = np.linspace(0.25, 1.5, 10)
+    return tuple(float(r) for r in np.concatenate([lo, hi]))
+
+
+DEFAULT_RES_RANGE = _default_res_range()
+
+# reference R/consensusClust.R:892 — testSplits' own default sweep.
+TEST_SPLITS_RES_RANGE = tuple(float(r) for r in np.arange(0.1, 3.4 + 1e-9, 0.15))
+
+# reference R/consensusClust.R:803-804 — the null-simulation sweep is hardcoded.
+NULL_SIM_RES_RANGE = tuple(
+    float(r) for r in np.concatenate([np.arange(0.01, 0.3, 0.03), np.arange(0.3, 2.0 + 1e-9, 0.2)])
+)
+NULL_SIM_MIN_SIZE = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """All knobs of the pipeline; defaults match the reference signature.
+
+    Reference lines given per field (R/consensusClust.R unless noted).
+    """
+
+    # --- preprocessing (L2) -------------------------------------------------
+    size_factors: Union[str, np.ndarray] = "deconvolution"  # :123; "deconvolution" | "libsize" | vector
+    n_var_features: int = 2000            # :124 nVarFeatures
+    variable_features: Optional[Sequence] = None  # :123 (None => deviance selection)
+    vars_to_regress: Optional[object] = None      # :124 (None | array [n_cells, n_cov] | names)
+    regress_method: str = "lm"            # :125 ("lm" | "glmGamPoi" | "poisson")
+    skip_first_regression: Union[bool, Sequence[str]] = False  # :125
+
+    # --- dimensionality reduction (L3) --------------------------------------
+    pc_num: Union[str, int] = "find"      # :123 ("find" | "getDenoisedPCs" | int)
+    pc_var: float = 0.2                   # :122 pcVar — cum-sdev fraction for the elbow rule
+    pca_method: str = "irlba"             # :124 — validated but never used by the reference
+    scale: bool = True                    # :124
+    center: bool = True                   # :124
+    interactive: bool = False             # :122
+
+    # --- clustering engine (L4) ---------------------------------------------
+    cluster_fun: str = "leiden"           # :126 ("leiden" | "louvain")
+    res_range: Sequence[float] = DEFAULT_RES_RANGE  # :126
+    k_num: Sequence[int] = (10, 15, 20)   # :127
+    mode: str = "robust"                  # :127 ("robust" | "granular")
+
+    # --- consensus layer (L5) -----------------------------------------------
+    nboots: int = 100                     # :124
+    boot_size: float = 0.9                # :127 bootSize — resample fraction
+    min_stability: float = 0.175          # :125
+
+    # --- statistical testing (L6) -------------------------------------------
+    alpha: float = 0.05                   # :122
+    silhouette_thresh: float = 0.45       # :126
+    test_splits_separately: bool = False  # :125 (sic: reference spells it "seperately")
+    n_null_sims: int = 20                 # :933 — per adaptive round
+
+    # --- hierarchy / iteration (L7) -----------------------------------------
+    iterate: bool = False                 # :122
+    min_size: int = 50                    # :127
+    depth: int = 1                        # :128 (internal)
+
+    # --- runtime ------------------------------------------------------------
+    seed: int = 123                       # :128
+    assay: str = "RNA"                    # :127 (Seurat adapter only)
+
+    # --- TPU-specific static-shape knobs (no reference counterpart) ---------
+    max_clusters: int = 64      # padded one-hot width for labels everywhere
+    boot_batch: int = 0         # boots jitted per device batch; 0 => auto
+    compute_dtype: str = "float32"
+    use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
+    progress: bool = False      # structured per-level logging
+
+    def __post_init__(self):
+        if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
+            raise ValueError(f"pc_num must be an int, 'find' or 'getDenoisedPCs'; got {self.pc_num!r}")
+        if self.mode not in ("robust", "granular"):
+            raise ValueError(f"mode must be 'robust' or 'granular'; got {self.mode!r}")
+        if self.cluster_fun not in ("leiden", "louvain"):
+            raise ValueError(f"cluster_fun must be 'leiden' or 'louvain'; got {self.cluster_fun!r}")
+        if self.regress_method not in ("lm", "glmGamPoi", "poisson"):
+            raise ValueError(f"regress_method must be 'lm', 'glmGamPoi' or 'poisson'")
+        if not (0.0 < self.boot_size <= 1.0):
+            raise ValueError("boot_size must be in (0, 1]")
+        if isinstance(self.size_factors, str) and self.size_factors not in (
+            "deconvolution",
+            "libsize",
+        ):
+            raise ValueError("size_factors must be 'deconvolution', 'libsize' or a vector")
+        if not (0.0 < self.pc_var <= 1.0):
+            raise ValueError("pc_var must be in (0, 1]")
+        if self.nboots < 0 or self.min_size < 0 or self.n_var_features <= 0:
+            raise ValueError("nboots/min_size must be >= 0, n_var_features > 0")
+
+    def replace(self, **kw) -> "ClusterConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,1 @@
+from consensusclustr_tpu.linalg.pca import truncated_pca, choose_pc_num, pca_for_config

@@ -1,0 +1,180 @@
+"""Truncated PCA via randomized SVD with implicit centring/scaling operators.
+
+Equivalent of irlba::prcomp_irlba as used at reference R/consensusClust.R:339,
+:369 and :790 (truncated PCA of the HVG-subset normalised matrix with per-gene
+centring and scaling), and of the pcNum selection rules:
+
+  * "find"/elbow path (:337-365): 50-PC decomposition, then
+    pcNum = max(first k with cum-sdev fraction > pcVar, 5).
+  * numeric pcNum > 30 silently re-enters the "find" path (:338) — replicated
+    deliberately, see docs/quirks.md item 3.
+  * "getDenoisedPCs" path (:321-335): Poisson technical-variance model, keep
+    PCs covering the biological variance (scran::getDenoisedPCs capability).
+
+TPU-first: the centred/scaled matrix A = (X - mu) / sigma is never
+materialised; every product folds the centring into the matmul
+(A @ M = X @ (M/sigma) - 1 (mu/sigma)^T M). Randomized SVD (Halko et al.)
+with q power iterations is all large-matmul work for the MXU, unlike the
+reference's Lanczos iteration which is a sequential chain of matvecs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class PCAResult(NamedTuple):
+    scores: jax.Array    # [n_cells, k]  (U * S, == prcomp's $x)
+    sdev: jax.Array      # [k]           (singular values / sqrt(n-1))
+    loadings: jax.Array  # [n_genes, k]  (V, == prcomp's $rotation)
+
+
+def _stats(x, center: bool, scale: bool):
+    mu = jnp.mean(x, axis=0) if center else jnp.zeros((x.shape[1],), x.dtype)
+    if scale:
+        # ddof=1 to match R's sd()
+        n = x.shape[0]
+        var = jnp.sum((x - mu[None, :]) ** 2, axis=0) / jnp.maximum(n - 1, 1)
+        sigma = jnp.sqrt(var)
+        sigma = jnp.where(sigma > 1e-8, sigma, 1.0)
+    else:
+        sigma = jnp.ones((x.shape[1],), x.dtype)
+    return mu, sigma
+
+
+@functools.partial(jax.jit, static_argnames=("k", "center", "scale", "n_oversample", "n_power_iters"))
+def truncated_pca(
+    x: jax.Array,
+    k: int,
+    *,
+    center: bool = True,
+    scale: bool = True,
+    key: jax.Array = None,
+    n_oversample: int = 10,
+    n_power_iters: int = 2,
+) -> PCAResult:
+    """Randomized truncated SVD of the implicitly centred/scaled [n, g] matrix.
+
+    Note: unlike the reference, `scale` is gated on `scale` — the reference
+    gates it on `center` (R/consensusClust.R:339/:369; quirk 5).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, g = x.shape
+    k = min(k, min(n, g))
+    r = min(k + n_oversample, min(n, g))
+    if key is None:
+        key = jax.random.key(0)
+
+    mu, sigma = _stats(x, center, scale)
+    mu_s = mu / sigma  # centring vector in the scaled space
+
+    def a_mat(m):  # A @ m, m: [g, r]
+        return x @ (m / sigma[:, None]) - jnp.ones((n, 1), x.dtype) * (mu_s @ m)[None, :]
+
+    def at_mat(m):  # A^T @ m, m: [n, r]
+        return (x.T @ m) / sigma[:, None] - mu_s[:, None] * jnp.sum(m, axis=0)[None, :]
+
+    omega = jax.random.normal(key, (g, r), x.dtype)
+    y = a_mat(omega)
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_power_iters):
+        z, _ = jnp.linalg.qr(at_mat(q))
+        q, _ = jnp.linalg.qr(a_mat(z))
+
+    b = at_mat(q).T  # [r, g] = Q^T A
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    scores = u[:, :k] * s[None, :k]
+    sdev = s[:k] / jnp.sqrt(jnp.maximum(n - 1, 1))
+    return PCAResult(scores=scores, sdev=sdev, loadings=vt[:k].T)
+
+
+def choose_pc_num(sdev50: jax.Array, pc_var: float = 0.2, floor: int = 5) -> int:
+    """Elbow rule (reference :356): smallest k with
+    cumsum(sdev[1:k]) / sum(sdev[1:50]) > pc_var, floored at 5."""
+    sdev50 = jnp.asarray(sdev50)
+    frac = jnp.cumsum(sdev50) / jnp.maximum(jnp.sum(sdev50), 1e-12)
+    k = int(jnp.argmax(frac > pc_var)) + 1
+    return max(k, floor)
+
+
+def denoised_pc_num(
+    x_norm: jax.Array,
+    counts: jax.Array,
+    size_factors: jax.Array,
+    sdev50_unscaled: jax.Array,
+    max_pcs: int = 50,
+) -> int:
+    """scran getDenoisedPCs capability (reference :321-335): keep the number
+    of PCs whose variance sums to the estimated biological variance.
+
+    `sdev50_unscaled` must come from a PCA of the *unscaled* centred
+    log-expression (scran operates on unscaled variances), so PC variances and
+    the per-gene variance decomposition share units.
+
+    Technical per-gene variance of y = log1p(c/sf) with c ~ Poisson(mu_g sf_j)
+    by the delta method at the mean: Var(y | g, j) ~ mu_g / (sf_j (1+mu_g)^2),
+    where mu_g is the per-gene rate (mean of counts/sf), then averaged over
+    cells.
+    """
+    x_norm = jnp.asarray(x_norm, jnp.float32)
+    counts = jnp.asarray(counts, jnp.float32)
+    sf = jnp.asarray(size_factors, jnp.float32)[:, None]
+    total_var = jnp.var(x_norm, axis=0, ddof=1)
+    mu = jnp.mean(counts / sf, axis=0)[None, :]  # per-gene rate, [1, g]
+    tech = jnp.mean((mu / sf) / jnp.square(1.0 + mu), axis=0)
+    bio_total = jnp.sum(jnp.maximum(total_var - tech, 0.0))
+    pc_var = sdev50_unscaled**2
+    covered = jnp.cumsum(pc_var)
+    k = int(jnp.argmax(covered >= bio_total)) + 1
+    if float(covered[-1]) < float(bio_total):
+        k = int(pc_var.shape[0])
+    return max(min(k, max_pcs), 5)
+
+
+def pca_for_config(
+    x_norm: jax.Array,
+    pc_num: Union[str, int],
+    pc_var: float,
+    *,
+    center: bool = True,
+    scale: bool = True,
+    key: jax.Array = None,
+    counts: jax.Array = None,
+    size_factors: jax.Array = None,
+) -> Tuple[jax.Array, int, PCAResult]:
+    """Full pcNum-selection + PCA flow of reference :321-382.
+
+    Returns (scores[:, :pc_num], pc_num, full PCAResult).
+    """
+    n = x_norm.shape[0]
+    needs_find = (isinstance(pc_num, str)) or (int(pc_num) > 30)  # :338 override
+    if needs_find:
+        k50 = min(50, min(n, x_norm.shape[1]))
+        res = truncated_pca(x_norm, k50, center=center, scale=scale, key=key)
+        if (
+            pc_num == "getDenoisedPCs"
+            and counts is not None
+            and size_factors is not None
+            and n > 400
+        ):
+            # scran's variance decomposition lives in unscaled log-expression
+            # units, so the PC spectrum for the denoised rule must too.
+            if scale:
+                res_u = truncated_pca(x_norm, k50, center=center, scale=False, key=key)
+                sdev_u = res_u.sdev
+            else:
+                sdev_u = res.sdev
+            chosen = denoised_pc_num(x_norm, counts, size_factors, sdev_u)
+        else:
+            chosen = choose_pc_num(res.sdev, pc_var)
+        chosen = min(chosen, k50)
+        return res.scores[:, :chosen], chosen, res
+    chosen = int(pc_num)
+    chosen = min(chosen, min(n, x_norm.shape[1]))
+    res = truncated_pca(x_norm, chosen, center=center, scale=scale, key=key)
+    return res.scores, chosen, res

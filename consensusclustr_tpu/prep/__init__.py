@@ -1,0 +1,9 @@
+from consensusclustr_tpu.prep.sizefactors import (
+    libsize_factors,
+    deconvolution_factors,
+    stabilize_size_factors,
+    compute_size_factors,
+)
+from consensusclustr_tpu.prep.transform import shifted_log, normalize_counts
+from consensusclustr_tpu.prep.hvg import binomial_deviance, poisson_deviance, select_hvgs
+from consensusclustr_tpu.prep.regress import regress_features

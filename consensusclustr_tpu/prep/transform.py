@@ -1,0 +1,36 @@
+"""Count normalisation transforms.
+
+Equivalent of transformGamPoi::shifted_log_transform as called at
+reference R/consensusClust.R:287 and :779 (pseudo-count 1, size factors either
+precomputed or the "deconvolution" string): y = log1p(x / (sf * pc)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.prep.sizefactors import compute_size_factors
+
+
+def shifted_log(counts: jax.Array, size_factors: jax.Array, pseudo_count: float = 1.0) -> jax.Array:
+    """Shifted-log transform log1p(x / (sf * pc)), rows = cells."""
+    counts = jnp.asarray(counts, jnp.float32)
+    sf = jnp.asarray(size_factors, jnp.float32)
+    return jnp.log1p(counts / (sf[:, None] * pseudo_count))
+
+
+def normalize_counts(
+    counts: jax.Array,
+    size_factors: Union[str, np.ndarray] = "deconvolution",
+    pseudo_count: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Size factors (with the reference's stabilisation pass) + shifted log.
+
+    Mirrors reference R/consensusClust.R:274-288. Returns (norm_counts, sf).
+    """
+    sf = compute_size_factors(counts, size_factors)
+    return shifted_log(counts, sf, pseudo_count), sf

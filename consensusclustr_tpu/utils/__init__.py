@@ -1,0 +1,2 @@
+from consensusclustr_tpu.utils.rng import root_key, boot_key, sim_key
+from consensusclustr_tpu.utils.log import get_logger, LevelLog

@@ -1,0 +1,60 @@
+"""Structured per-level observability.
+
+The reference's only observability is one message("Failed Test")
+(reference R/consensusClust.R:613). The build plan (SURVEY §5) calls for a
+structured per-level log: cells, pcNum, candidate scores, best silhouette,
+p-values, merges. ``LevelLog`` collects those records; ``get_logger`` is plain
+stdlib logging so the package never prints unless asked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+
+def get_logger(name: str = "consensusclustr_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("[%(name)s] %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+@dataclasses.dataclass
+class LevelLog:
+    """Append-only record of what happened at one recursion level."""
+
+    records: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    enabled: bool = False
+    _t0: float = dataclasses.field(default_factory=time.monotonic)
+
+    def event(self, kind: str, **fields: Any) -> None:
+        rec = {"t": round(time.monotonic() - self._t0, 4), "kind": kind, **fields}
+        self.records.append(rec)
+        if self.enabled:
+            get_logger().info(json.dumps(rec, default=_jsonable))
+
+    def child(self) -> "LevelLog":
+        return LevelLog(records=self.records, enabled=self.enabled, _t0=self._t0)
+
+
+def _jsonable(x: Any):
+    try:
+        import numpy as np
+
+        if isinstance(x, (np.integer,)):
+            return int(x)
+        if isinstance(x, (np.floating,)):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+    except Exception:
+        pass
+    return str(x)

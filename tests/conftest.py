@@ -1,0 +1,45 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY §4 item 4).
+
+Must run before the first `import jax` anywhere in the test process, which
+pytest guarantees by importing conftest first.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: driver env may pin a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize imports jax before conftest runs, so the env var
+# alone is too late — override the already-captured config value too.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_blobs(n_per=60, n_genes=40, n_clusters=3, sep=6.0, seed=0):
+    """Planted gaussian blobs in expression space + Poisson counts."""
+    r = np.random.default_rng(seed)
+    centers = r.normal(0.0, sep, size=(n_clusters, n_genes))
+    rows, labels = [], []
+    for c in range(n_clusters):
+        rows.append(centers[c][None, :] + r.normal(0, 1.0, size=(n_per, n_genes)))
+        labels += [c] * n_per
+    x = np.concatenate(rows, axis=0)
+    return x.astype(np.float32), np.asarray(labels)
+
+
+@pytest.fixture()
+def blobs():
+    return make_blobs()

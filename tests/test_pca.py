@@ -1,0 +1,91 @@
+"""Truncated-PCA parity with dense SVD oracles (SURVEY §4 item 1)."""
+
+import numpy as np
+
+from consensusclustr_tpu.linalg import truncated_pca, choose_pc_num, pca_for_config
+
+
+def _oracle_scores(x, k, center=True, scale=True):
+    mu = x.mean(0) if center else np.zeros(x.shape[1])
+    a = x - mu
+    if scale:
+        sd = x.std(0, ddof=1)
+        sd[sd < 1e-8] = 1.0
+        a = a / sd
+    u, s, vt = np.linalg.svd(a, full_matrices=False)
+    return u[:, :k] * s[:k], s / np.sqrt(x.shape[0] - 1)
+
+
+def _low_rank(rng, n=120, g=30, rank=6, scale=8.0):
+    """Rank-`rank` matrix with a separated spectrum: randomized SVD with
+    oversampling >= rank recovers the top components exactly."""
+    a = rng.normal(size=(n, rank))
+    b = rng.normal(size=(rank, g))
+    s = scale ** -np.arange(rank)  # geometric spectrum, well separated
+    return (a * s[None, :] * 50.0) @ b
+
+
+def _assert_component_match(got, exp, cos_tol=0.999):
+    """Per-component cosine similarity — the right fidelity bar for a
+    float32 randomized method vs a float64 dense oracle."""
+    for c in range(exp.shape[1]):
+        ge, ee = got[:, c], exp[:, c]
+        cos = abs(np.dot(ge, ee)) / (np.linalg.norm(ge) * np.linalg.norm(ee) + 1e-30)
+        assert cos > cos_tol, f"component {c}: cos={cos}"
+        # magnitudes agree too (scores carry the singular values)
+        np.testing.assert_allclose(np.linalg.norm(ge), np.linalg.norm(ee), rtol=5e-3)
+
+
+def test_scores_match_dense_svd(rng):
+    x = _low_rank(rng).astype(np.float32)
+    res = truncated_pca(x, 5, center=True, scale=False)
+    exp_scores, exp_sdev = _oracle_scores(x, 5, scale=False)
+    _assert_component_match(np.asarray(res.scores), exp_scores)
+    np.testing.assert_allclose(np.asarray(res.sdev), exp_sdev[:5], rtol=5e-3)
+
+
+def test_scaled_scores_match_dense_svd(rng):
+    x = _low_rank(rng).astype(np.float32)
+    res = truncated_pca(x, 4, center=True, scale=True)
+    exp_scores, exp_sdev = _oracle_scores(x, 4, center=True, scale=True)
+    _assert_component_match(np.asarray(res.scores), exp_scores, cos_tol=0.99)
+    np.testing.assert_allclose(np.asarray(res.sdev), exp_sdev[:4], rtol=1e-2)
+
+
+def test_no_center_no_scale(rng):
+    x = _low_rank(rng, n=60, g=20, rank=5).astype(np.float32)
+    res = truncated_pca(x, 4, center=False, scale=False)
+    u, s, vt = np.linalg.svd(x, full_matrices=False)
+    _assert_component_match(np.asarray(res.scores), u[:, :4] * s[:4])
+
+
+def test_scale_gated_on_scale_param(rng):
+    # quirk 5 fix: scale must be controlled by `scale`, not `center`
+    x = rng.normal(size=(80, 10)).astype(np.float32)
+    x[:, 0] *= 100.0  # dominant-variance gene
+    res_scaled = truncated_pca(x, 2, center=True, scale=True)
+    res_raw = truncated_pca(x, 2, center=True, scale=False)
+    load_scaled = np.abs(np.asarray(res_scaled.loadings)[0, 0])
+    load_raw = np.abs(np.asarray(res_raw.loadings)[0, 0])
+    assert load_raw > 0.9       # unscaled: PC1 is the big gene
+    assert load_scaled < 0.75   # scaled: big gene no longer dominates
+
+
+def test_choose_pc_num_rule():
+    sdev = np.array([5.0, 3.0, 2.0] + [0.1] * 47)
+    # cumfrac after 1 PC: 5/14.7=0.34 > 0.2 → k=1 → floored to 5
+    assert choose_pc_num(sdev, pc_var=0.2) == 5
+    assert choose_pc_num(sdev, pc_var=0.6) == 5  # k=3 (0.68) floored to 5
+    # total sdev = 14.7; cum after 3 PCs = 10.0; need > 13.965 → 40 more 0.1-PCs
+    assert choose_pc_num(sdev, pc_var=0.95, floor=5) == 43
+
+
+def test_pca_for_config_numeric_and_find(rng):
+    x = rng.normal(size=(100, 60)).astype(np.float32)
+    scores, k, _ = pca_for_config(x, 7, 0.2)
+    assert k == 7 and scores.shape == (100, 7)
+    scores, k, _ = pca_for_config(x, "find", 0.2)
+    assert k >= 5 and scores.shape == (100, k)
+    # numeric > 30 re-enters the find path (reference :338 behavior)
+    scores, k, _ = pca_for_config(x, 45, 0.2)
+    assert k >= 5

@@ -1,0 +1,196 @@
+"""The clustering kernel: kNN -> SNN -> Leiden over a (k, resolution) grid.
+
+Equivalent of the reference's `getClustAssignments`
+(reference R/consensusClust.R:650-692), the unit of work for the whole TPU
+design (SURVEY §3.5): for each k in k_num and resolution in res_range, build
+the SNN graph and run community detection, score each candidate with the
+reference's floor rules (:662-669), and either pick the argmax-silhouette
+candidate ("robust") or keep all candidates ("granular").
+
+`cluster_grid` is a pure jitted function of fixed shapes, vmap-able over a
+bootstrap axis; `get_clust_assignments` is the public, host-driven wrapper
+with the reference's bootstrap-alignment semantics (unsampled cells -> -1,
+duplicated cells -> first sampled copy; quirk 14).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.config import DEFAULT_RES_RANGE
+from consensusclustr_tpu.cluster.knn import knn_points
+from consensusclustr_tpu.cluster.snn import snn_graph
+from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
+from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+from consensusclustr_tpu.utils.rng import cluster_key, root_key
+
+
+class GridResult(NamedTuple):
+    labels: jax.Array      # [n_cand, m] compact int32
+    n_clusters: jax.Array  # [n_cand] int32
+    scores: jax.Array      # [n_cand] float32
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters", "singleton_floor"))
+def candidate_score(
+    x: jax.Array,
+    labels: jax.Array,
+    n_clusters: jax.Array,
+    overflow: jax.Array,
+    min_size: jax.Array,
+    max_clusters: int,
+    singleton_floor: bool = False,
+) -> jax.Array:
+    """Reference scoring rules (:662-669 boot path, :445-453 consensus path):
+
+      * all clusters singletons        -> -1    (consensus path only)
+      * any cluster size <= min_size   -> 0.15
+      * single cluster (sizes ok)      -> 0
+      * otherwise                      -> mean approx-silhouette
+      * > max_clusters communities     -> 0.15 (fragmentation == small clusters)
+    """
+    n = labels.shape[0]
+    counts = jnp.zeros((max_clusters,), jnp.float32).at[labels].add(1.0)
+    occupied = counts > 0
+    min_count = jnp.min(jnp.where(occupied, counts, jnp.inf))
+    any_small = (min_count <= min_size) | overflow
+    single = n_clusters <= 1
+    sil = mean_silhouette_score(x, labels, max_clusters)
+    score = jnp.where(any_small, 0.15, jnp.where(single, 0.0, sil))
+    if singleton_floor:
+        all_singleton = n_clusters >= n
+        score = jnp.where(all_singleton, -1.0, score)
+    return score
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac", "singleton_floor"),
+)
+def cluster_grid(
+    key: jax.Array,
+    x: jax.Array,
+    res_list: jax.Array,
+    k_list: Tuple[int, ...],
+    min_size: jax.Array,
+    max_clusters: int = 64,
+    n_iters: int = 20,
+    update_frac: float = 0.5,
+    singleton_floor: bool = False,
+) -> GridResult:
+    """All (k, resolution) candidates for one [m, d] point set.
+
+    The kNN/SNN graph is built once per k (it does not depend on resolution);
+    Leiden is vmapped over the resolution axis — the reference instead runs
+    6000 sequential igraph calls per level (SURVEY §3.1 hot loop #1).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    res_list = jnp.asarray(res_list, jnp.float32)
+    r = res_list.shape[0]
+
+    all_labels, all_nc, all_scores = [], [], []
+    for ki, k in enumerate(k_list):
+        idx, _ = knn_points(x, k)
+        graph = snn_graph(idx)
+        keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
+
+        def one_res(kk, res):
+            raw = leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
+            compact, n_c, overflow = compact_labels(raw, max_clusters)
+            score = candidate_score(
+                x, compact, n_c, overflow, min_size, max_clusters, singleton_floor
+            )
+            return compact, n_c, score
+
+        labels_k, nc_k, scores_k = jax.vmap(one_res)(keys, res_list)
+        all_labels.append(labels_k)
+        all_nc.append(nc_k)
+        all_scores.append(scores_k)
+
+    return GridResult(
+        labels=jnp.concatenate(all_labels, axis=0),
+        n_clusters=jnp.concatenate(all_nc, axis=0),
+        scores=jnp.concatenate(all_scores, axis=0),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_cells",))
+def first_occurrence(boot_idx: jax.Array, n_cells: int) -> jax.Array:
+    """first_pos[c] = index of the first bootstrap row sampling cell c, or m.
+
+    Mirrors R's first-match name lookup used to align duplicated bootstrap
+    rows back to cells (reference :673; quirk 14).
+    """
+    m = boot_idx.shape[0]
+    n = n_cells
+    first = jnp.full((n,), m, jnp.int32)
+    positions = jnp.arange(m, dtype=jnp.int32)
+    return first.at[boot_idx].min(positions)
+
+
+def align_to_cells(labels: jax.Array, boot_idx: jax.Array, n_cells: int) -> jax.Array:
+    """Map per-row labels [.., m] to per-cell labels [.., n_cells]; unsampled
+    cells get -1 (the reference's NA, SURVEY §7.1 mask recasting)."""
+    first = first_occurrence(boot_idx, int(n_cells))  # [n]
+    m = boot_idx.shape[0]
+    sampled = first < m
+    safe = jnp.minimum(first, m - 1)
+    gathered = jnp.take(labels, safe, axis=-1)
+    return jnp.where(sampled, gathered, -1)
+
+
+def get_clust_assignments(
+    pca,
+    cluster_fun: str = "leiden",
+    res_range: Sequence[float] = DEFAULT_RES_RANGE,
+    k_num: Sequence[int] = (10, 15, 20),
+    mode: str = "robust",
+    seed: int = 123,
+    min_size: int = 50,
+    boot_idx: Optional[np.ndarray] = None,
+    n_cells: Optional[int] = None,
+    max_clusters: int = 64,
+    key: Optional[jax.Array] = None,
+    n_iters: int = 20,
+):
+    """Public engine API (reference export, NAMESPACE:5).
+
+    pca: [m, d] embedding (possibly a bootstrap slice). When `boot_idx` and
+    `n_cells` are given, output is aligned to the original cells with -1 for
+    unsampled ones. Returns (labels, score) in "robust" mode (argmax
+    silhouette candidate, ties to the last as in the reference's
+    ties.method="last") or a [n_cand, n] label matrix in "granular" mode.
+
+    `cluster_fun` selects leiden/louvain; both map to the fixed-iteration
+    masked local-move kernel (docs/quirks.md D2/item 6).
+    """
+    del cluster_fun  # one kernel serves both (quirks item 6 / D2)
+    if key is None:
+        key = root_key(seed)
+    x = jnp.asarray(pca, jnp.float32)
+    res = cluster_grid(
+        key,
+        x,
+        jnp.asarray(list(res_range), jnp.float32),
+        tuple(int(k) for k in k_num),
+        jnp.asarray(min_size, jnp.float32),
+        max_clusters=max_clusters,
+        n_iters=n_iters,
+    )
+    if mode == "robust":
+        # ties.method="last": argmax on the reversed array
+        scores = np.asarray(res.scores)
+        best = len(scores) - 1 - int(np.argmax(scores[::-1]))
+        labels = res.labels[best]
+        if boot_idx is not None:
+            labels = align_to_cells(labels, jnp.asarray(boot_idx, jnp.int32), int(n_cells))
+        return np.asarray(labels), float(scores[best])
+    labels = res.labels
+    if boot_idx is not None:
+        labels = align_to_cells(labels, jnp.asarray(boot_idx, jnp.int32), int(n_cells))
+    return np.asarray(labels)

@@ -1,0 +1,55 @@
+"""Brute-force k-nearest-neighbour search.
+
+Equivalent of dbscan::kNN's kd-tree (reference R/consensusClust.R:425) and of
+the kNN step inside bluster's SNNGraphParam (:656). kd-trees are
+anti-idiomatic on TPU; exact brute force is matmul-shaped (one n x n distance
+pass on the MXU + lax.top_k) and faster for n <= O(100k) (SURVEY §2.2).
+
+Both entry points are vmap-able over a bootstrap axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def knn_points(x: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN in Euclidean space, excluding self.
+
+    x: [n, d]. Returns (idx [n, k] int32, dist [n, k] float32), neighbours
+    sorted by increasing distance.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[0]
+    sq = jnp.sum(x * x, axis=1)
+    d2 = sq[:, None] - 2.0 * (x @ x.T) + sq[None, :]
+    d2 = jnp.maximum(d2, 0.0)
+    d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)  # exclude self
+    k_eff = min(k, n - 1)
+    neg, idx = jax.lax.top_k(-d2, k_eff)
+    if k_eff < k:  # degenerate tiny inputs: pad with the last neighbour
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        neg = jnp.concatenate([neg, jnp.repeat(neg[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), jnp.sqrt(-neg)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def knn_from_distance(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN given a precomputed [n, n] distance matrix (the consensus
+    Jaccard-distance path, reference :425)."""
+    d = jnp.asarray(d, jnp.float32)
+    n = d.shape[0]
+    d = d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    k_eff = min(k, n - 1)
+    neg, idx = jax.lax.top_k(-d, k_eff)
+    if k_eff < k:
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        neg = jnp.concatenate([neg, jnp.repeat(neg[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), -neg

@@ -1,0 +1,143 @@
+"""Cluster-quality metrics: approximate silhouette and pairwise Rand.
+
+Equivalents of bluster::approxSilhouette and bluster::pairwiseRand
+(reference R/consensusClust.R:447, :470, :518, :664, :811, :902, :990),
+reimplemented from their mathematical definitions as pure matmul/segment-sum
+programs (docs/quirks.md D4). Both take compacted labels (ids in [0, C)) and a
+static `max_clusters` so they jit/vmap with fixed shapes; empty clusters are
+masked, not dropped (SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_INF = jnp.inf
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters",))
+def approx_silhouette(
+    x: jax.Array,
+    labels: jax.Array,
+    max_clusters: int,
+    valid: jax.Array = None,
+) -> jax.Array:
+    """Centroid-based approximate silhouette per point (bluster's scheme).
+
+    Distance of point i to cluster c is sqrt(||x_i - mu_c||^2 + s_c) where
+    s_c is the mean squared distance of c's members to mu_c (the dispersion
+    correction that distinguishes approxSilhouette from a plain centroid
+    silhouette). silhouette_i = (b - a) / max(a, b) with a = own-cluster
+    distance, b = nearest other cluster.
+
+    valid: optional [n] bool mask; invalid points get silhouette 0 and do not
+    contribute to centroids.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, d = x.shape
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+    vf = valid.astype(jnp.float32)
+    lab = jnp.asarray(labels, jnp.int32)
+
+    counts = jnp.zeros((max_clusters,), jnp.float32).at[lab].add(vf)
+    sums = jnp.zeros((max_clusters, d), jnp.float32).at[lab].add(x * vf[:, None])
+    mu = sums / jnp.maximum(counts[:, None], 1.0)
+
+    # squared distances point -> every centroid: one matmul
+    x2 = jnp.sum(x * x, axis=1)
+    mu2 = jnp.sum(mu * mu, axis=1)
+    d2 = x2[:, None] - 2.0 * (x @ mu.T) + mu2[None, :]       # [n, C]
+    d2 = jnp.maximum(d2, 0.0)
+
+    # within-cluster mean squared distance to own centroid
+    own_d2 = jnp.take_along_axis(d2, lab[:, None], axis=1)[:, 0]
+    s_c = jnp.zeros((max_clusters,), jnp.float32).at[lab].add(own_d2 * vf)
+    s_c = s_c / jnp.maximum(counts, 1.0)
+
+    dist = jnp.sqrt(d2 + s_c[None, :])                        # [n, C]
+    empty = counts <= 0.0
+    dist = jnp.where(empty[None, :], _INF, dist)
+
+    a = jnp.take_along_axis(dist, lab[:, None], axis=1)[:, 0]
+    masked = dist.at[jnp.arange(n), lab].set(_INF)
+    b = jnp.min(masked, axis=1)
+    sil = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12)
+    sil = jnp.where(jnp.isfinite(sil), sil, 0.0)
+    return jnp.where(valid, sil, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters",))
+def mean_silhouette_score(
+    x: jax.Array, labels: jax.Array, max_clusters: int, valid: jax.Array = None
+) -> jax.Array:
+    sil = approx_silhouette(x, labels, max_clusters, valid)
+    if valid is None:
+        return jnp.mean(sil)
+    vf = valid.astype(jnp.float32)
+    return jnp.sum(sil * vf) / jnp.maximum(jnp.sum(vf), 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("max_ref", "max_alt"))
+def pairwise_rand(
+    ref: jax.Array,
+    alt: jax.Array,
+    max_ref: int,
+    max_alt: int,
+    valid: jax.Array = None,
+) -> jax.Array:
+    """Adjusted pairwise-Rand ratio matrix (bluster::pairwiseRand
+    mode="ratio", adjusted=TRUE capability, reference :470).
+
+    For ref clusters (i, j): consider unordered cell pairs with one cell in i,
+    one in j (both in i when i == j). A pair is "concordant" when the alt
+    clustering preserves its relation — together for i == j, apart for i != j.
+    The raw ratio (concordant / total pairs) is adjusted ARI-style by the
+    chance rate s = P(two random cells land together in alt):
+
+        diag:     (ratio - s) / (1 - s)
+        off-diag: (ratio - (1 - s)) / s... adjusted as (ratio - e) / (1 - e)
+                  with e = 1 - s, i.e. (ratio - (1 - s)) / s.
+
+    1.0 = perfectly stable; ~0 = chance level; can go negative. Cells where
+    `valid` is False (unsampled in a bootstrap) are excluded, matching the
+    reference's per-boot subsetting (:471). Empty ref pairs return NaN — the
+    caller applies the reference's NA -> 1 repair (:485).
+    """
+    ref = jnp.asarray(ref, jnp.int32)
+    alt = jnp.asarray(alt, jnp.int32)
+    if valid is None:
+        valid = jnp.ones(ref.shape, bool)
+    vf = valid.astype(jnp.float32)
+
+    # contingency table N[r, a] via one scatter-add
+    flat = ref * max_alt + alt
+    cont = jnp.zeros((max_ref * max_alt,), jnp.float32).at[flat].add(vf)
+    cont = cont.reshape(max_ref, max_alt)
+    n_r = jnp.sum(cont, axis=1)                       # ref cluster sizes
+    m_a = jnp.sum(cont, axis=0)                       # alt cluster sizes
+    n_tot = jnp.sum(n_r)
+
+    def choose2(v):
+        return v * (v - 1.0) / 2.0
+
+    # chance rate of "together in alt"
+    s = jnp.sum(choose2(m_a)) / jnp.maximum(choose2(n_tot), 1.0)
+
+    # diag: together-in-alt pairs within ref cluster i
+    same_alt_within = jnp.sum(choose2(cont), axis=1)  # [R]
+    tot_within = choose2(n_r)
+    ratio_diag = same_alt_within / jnp.where(tot_within > 0, tot_within, jnp.nan)
+    adj_diag = (ratio_diag - s) / jnp.maximum(1.0 - s, 1e-12)
+
+    # off-diag: cross pairs (one in i, one in j) apart in alt
+    cross_same = cont @ cont.T                        # together-in-alt cross pairs
+    tot_cross = n_r[:, None] * n_r[None, :]
+    ratio_off = 1.0 - cross_same / jnp.where(tot_cross > 0, tot_cross, jnp.nan)
+    adj_off = (ratio_off - (1.0 - s)) / jnp.maximum(s, 1e-12)
+
+    eye = jnp.eye(max_ref, dtype=bool)
+    return jnp.where(eye, jnp.broadcast_to(adj_diag[:, None], (max_ref, max_ref)), adj_off)

@@ -1,0 +1,81 @@
+"""Shared-nearest-neighbour graph with rank weights.
+
+Equivalent of bluster::neighborsToSNNGraph(type="rank") as used at
+reference R/consensusClust.R:426 and inside SNNGraphParam (:656): for nodes i
+and j sharing a neighbour m (each node counts itself at rank 0 of its own
+list), the edge weight is
+
+    w(i, j) = k - r/2,   r = min over shared m of (rank_i(m) + rank_j(m))
+
+One deviation for fixed shapes (docs/quirks.md D2/D3 family): edges are
+restricted to kNN pairs (j in kNN(i)), not every pair sharing a neighbour.
+j in kNN(i) implies a shared neighbour (j itself), so each node keeps exactly
+k out-edges — a dense [n, k] slot layout.
+
+The graph is symmetrised into [n, 2k] edge slots: slots 0..k-1 are out-edges,
+slots k..2k-1 carry the reverse of non-mutual out-edges (mutual pairs would
+otherwise be double-counted; the rank weight is symmetric so dedup is a mask).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SNNGraph(NamedTuple):
+    nbr: jax.Array    # [n, 2k] int32 neighbour ids (self-id where invalid)
+    w: jax.Array      # [n, 2k] float32 edge weights (0 where invalid)
+    deg: jax.Array    # [n] weighted degree
+    two_m: jax.Array  # scalar, total weight * 2 == deg.sum()
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _rank_weights(idx: jax.Array) -> jax.Array:
+    """w[i, a] = k - r/2 for edge i -> idx[i, a] under the rank rule."""
+    n, k = idx.shape
+    self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
+    lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k+1], rank = position
+    ranks = jnp.arange(k + 1, dtype=jnp.float32)
+    my = lists                                                # [n, k+1]
+    other = lists[idx]                                        # [n, k, k+1]
+    eq = my[:, None, :, None] == other[:, :, None, :]         # [n, k, k+1, k+1]
+    ranksum = ranks[:, None] + ranks[None, :]                 # [k+1, k+1]
+    r = jnp.min(jnp.where(eq, ranksum[None, None], jnp.inf), axis=(2, 3))  # [n, k]
+    return jnp.maximum(k - r / 2.0, 0.0)
+
+
+@jax.jit
+def snn_graph(idx: jax.Array) -> SNNGraph:
+    """Build the symmetric rank-weighted SNN graph from kNN indices [n, k]."""
+    idx = jnp.asarray(idx, jnp.int32)
+    n, k = idx.shape
+    w_out = _rank_weights(idx)                                # [n, k]
+
+    # mutual[i, a] = i in kNN(idx[i, a])
+    mutual = jnp.any(idx[idx] == jnp.arange(n, dtype=idx.dtype)[:, None, None], axis=2)
+
+    # Reverse edges: for non-mutual (i -> j), give j an in-edge slot (j -> i).
+    # Slot (j, a) receives the source whose a-th neighbour is j; collisions
+    # (several sources sharing the a-th-neighbour j) keep one arbitrarily —
+    # the dropped duplicates are rare and only shave edge weight, never add.
+    self_rows = jnp.broadcast_to(jnp.arange(n, dtype=idx.dtype)[:, None], idx.shape)
+    keep = ~mutual
+    src = jnp.where(keep, self_rows, -1)
+    cols = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], idx.shape)
+    rev_nbr = jnp.full((n, k), -1, jnp.int32).at[idx, cols].max(src)  # winner = max src id
+    got = rev_nbr >= 0
+    # Winner's weight comes from the *same* source edge: reverse slot (j, a)
+    # was written by edge (s, a) with idx[s, a] == j, so its weight is
+    # w_out[s, a] for the winning s.
+    safe_src = jnp.maximum(rev_nbr, 0)
+    rev_w = jnp.where(got, w_out[safe_src, cols], 0.0)
+    rev_nbr = jnp.where(got, rev_nbr, jnp.arange(n, dtype=jnp.int32)[:, None])
+
+    nbr = jnp.concatenate([idx, rev_nbr], axis=1)
+    w = jnp.concatenate([w_out, rev_w], axis=1)
+    deg = jnp.sum(w, axis=1)
+    return SNNGraph(nbr=nbr, w=w, deg=deg, two_m=jnp.sum(deg))

@@ -106,7 +106,9 @@ class ClusterConfig:
         if self.cluster_fun not in ("leiden", "louvain"):
             raise ValueError(f"cluster_fun must be 'leiden' or 'louvain'; got {self.cluster_fun!r}")
         if self.regress_method not in ("lm", "glmGamPoi", "poisson"):
-            raise ValueError(f"regress_method must be 'lm', 'glmGamPoi' or 'poisson'")
+            raise ValueError(
+                f"regress_method must be 'lm', 'glmGamPoi' or 'poisson'; got {self.regress_method!r}"
+            )
         if not (0.0 < self.boot_size <= 1.0):
             raise ValueError("boot_size must be in (0, 1]")
         if isinstance(self.size_factors, str) and self.size_factors not in (

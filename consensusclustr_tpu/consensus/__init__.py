@@ -1,0 +1,9 @@
+from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices, sampled_mask
+from consensusclustr_tpu.consensus.cocluster import coclustering_distance
+from consensusclustr_tpu.consensus.merge import (
+    cluster_mean_distance,
+    merge_small_clusters,
+    stability_matrix,
+    merge_unstable_clusters,
+)
+from consensusclustr_tpu.consensus.pipeline import consensus_cluster, ConsensusResult

@@ -1,0 +1,42 @@
+"""Bootstrap resample generation.
+
+Equivalent of the reference's per-boot `sample(rownames, bootSize*n,
+replace=TRUE)` (reference R/consensusClust.R:394). The R mechanism — indexing
+by duplicated rownames with first-match lookup — becomes an explicit
+`int32 idx[boot, m]` gather plus masks (SURVEY §7.1; quirk 14): duplicates of
+a cell all map to the same PCA row by construction, and alignment back to
+cells takes each cell's first sampled copy (cluster.engine.align_to_cells).
+
+Keys fold in the boot id, so resamples are identical regardless of device
+count or batch order (SURVEY §2.4 RNG row).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from consensusclustr_tpu.utils.rng import boot_key
+
+
+@functools.partial(jax.jit, static_argnames=("n", "nboots", "m"))
+def bootstrap_indices(key: jax.Array, n: int, nboots: int, m: int) -> jax.Array:
+    """[nboots, m] int32 cell indices, sampled uniformly with replacement."""
+
+    def one(b):
+        return jax.random.randint(boot_key(key, b), (m,), 0, n, dtype=jnp.int32)
+
+    return jax.vmap(one)(jnp.arange(nboots))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def sampled_mask(idx: jax.Array, n: int) -> jax.Array:
+    """[.., n] bool: cell appears at least once in the resample."""
+    shape = idx.shape[:-1] + (n,)
+    flat = idx.reshape(-1, idx.shape[-1])
+    out = jnp.zeros((flat.shape[0], n), bool)
+    rows = jnp.broadcast_to(jnp.arange(flat.shape[0])[:, None], flat.shape)
+    out = out.at[rows, flat].set(True)
+    return out.reshape(shape)

@@ -1,0 +1,67 @@
+"""Co-clustering (consensus Jaccard) distance.
+
+Equivalent of the reference's only first-party native code — the inline
+RcppArmadillo kernel applied over all O(n^2) pairs by parallelDist/OpenMP
+(reference R/consensusClust.R:411-421):
+
+    jaccard(i, j) = #(L_i == L_j  and both sampled) / #(both sampled)
+    dist = 1 - jaccard
+
+TPU recasting (SURVEY §2.2 row 1): labels are one-hot encoded per assignment
+column, so the agreement count is a batched matmul —
+agree = sum_b onehot_b @ onehot_b^T — which rides the MXU; the union count is
+the same matmul on the validity masks. Accumulation is chunked over the boot
+axis with lax.scan so the [B, n, C] one-hots never materialise at once.
+
+The Pallas int8 tile kernel (ops/pallas_cocluster.py) is the bandwidth-lean
+variant; this einsum path is the portable default and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters", "chunk"))
+def coclustering_distance(
+    labels: jax.Array,
+    max_clusters: int = 64,
+    chunk: int = 32,
+) -> jax.Array:
+    """labels: [B, n] int32, -1 == not sampled in that column.
+
+    Returns [n, n] float32 distance, diagonal forced to 0. Pairs never
+    co-sampled (union 0) get distance 1 (the R kernel's 0/0 NaN would poison
+    downstream kNN; the reference effectively never hits it at its default
+    nboots — documented deviation).
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    b, n = labels.shape
+    pad = (-b) % chunk
+    if pad:
+        labels = jnp.concatenate([labels, jnp.full((pad, n), -1, jnp.int32)], axis=0)
+    labels = labels.reshape(-1, chunk, n)
+
+    cvals = jnp.arange(max_clusters, dtype=jnp.int32)
+
+    def body(carry, chunk_labels):
+        agree, union = carry
+        valid = (chunk_labels >= 0).astype(jnp.bfloat16)              # [c, n]
+        onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)
+        onehot = onehot * valid[:, :, None]                            # [c, n, C]
+        agree = agree + jnp.einsum(
+            "cik,cjk->ij", onehot, onehot, preferred_element_type=jnp.float32
+        )
+        union = union + jnp.einsum(
+            "ci,cj->ij", valid, valid, preferred_element_type=jnp.float32
+        )
+        return (agree, union), None
+
+    zero = jnp.zeros((n, n), jnp.float32)
+    (agree, union), _ = jax.lax.scan(body, (zero, zero), labels)
+    jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
+    dist = 1.0 - jac
+    return dist.at[jnp.arange(n), jnp.arange(n)].set(0.0)

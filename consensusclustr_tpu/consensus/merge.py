@@ -1,0 +1,146 @@
+"""Cluster merging: small-cluster absorption and stability-based merging.
+
+Equivalents of the reference's two merge loops:
+
+  * small-cluster merge (reference R/consensusClust.R:461-467, 504-510):
+    while the smallest cluster is below a threshold, fold it into the cluster
+    with the nearest centroid under mean inter-member distance
+    (determineHierachy(return="distance") semantics, :699-735);
+  * stability merge (:469-497): per bootstrap, the pairwise adjusted-Rand
+    ratio between the consensus clustering and the boot clustering on the
+    boot's sampled cells; averaged over boots (NaN -> 1, diag -> 1); while the
+    matrix minimum is below `min_stability`, merge the offending pair and
+    recompute.
+
+Merge loops run on host over cluster-count-sized matrices (SURVEY §7.1 —
+irregular control is host-driven); the per-boot Rand passes and the mean
+inter-member distances are device segment-sums. Stability rows are indexed by
+compacted cluster id throughout, fixing the reference's dimnames mismatch
+(docs/quirks.md item 8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.cluster.metrics import pairwise_rand
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters",))
+def cluster_mean_distance(
+    dist: jax.Array, labels: jax.Array, max_clusters: int
+) -> jax.Array:
+    """[C, C] mean of cell-cell distances between members of each pair
+    (the centroid-linkage matrix of determineHierachy, reference :699-735).
+    Empty clusters get +inf rows/cols."""
+    lab = jnp.asarray(labels, jnp.int32)
+    n = lab.shape[0]
+    onehot = (lab[:, None] == jnp.arange(max_clusters)[None, :]).astype(jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    sums = onehot.T @ jnp.asarray(dist, jnp.float32) @ onehot          # [C, C]
+    denom = jnp.outer(counts, counts)
+    out = jnp.where(denom > 0, sums / jnp.maximum(denom, 1.0), jnp.inf)
+    return out
+
+
+def merge_small_clusters(
+    dist: np.ndarray,
+    labels: np.ndarray,
+    min_size: int,
+    max_clusters: int,
+) -> np.ndarray:
+    """Host-driven loop: fold the smallest under-threshold cluster into its
+    nearest neighbour by mean inter-member distance (reference :462-467)."""
+    labels = np.asarray(labels, np.int32).copy()
+    while True:
+        ids, counts = np.unique(labels, return_counts=True)
+        if len(ids) <= 1:
+            return labels
+        smallest = ids[np.argmin(counts)]
+        if counts.min() >= min_size:
+            return labels
+        cd = np.asarray(cluster_mean_distance(dist, labels, max_clusters))
+        row = cd[smallest].copy()
+        row[smallest] = np.inf
+        row[[c for c in range(max_clusters) if c not in ids]] = np.inf
+        target = int(np.argmin(row))
+        labels[labels == smallest] = target
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters", "max_boot_clusters"))
+def stability_matrix(
+    consensus: jax.Array,
+    boot_labels: jax.Array,
+    max_clusters: int,
+    max_boot_clusters: int = 64,
+) -> jax.Array:
+    """Mean pairwise-Rand ratio across bootstraps (reference :470-481).
+
+    consensus: [n] compact ids; boot_labels: [B, n] with -1 for unsampled.
+    Per boot the comparison is restricted to sampled cells (:471). NaNs
+    (empty pairs) -> 1 and diag -> 1 repairs (:485) are applied after the
+    mean, as in the reference.
+    """
+    cons = jnp.asarray(consensus, jnp.int32)
+
+    def per_boot(bl):
+        valid = bl >= 0
+        m = pairwise_rand(cons, jnp.maximum(bl, 0), max_clusters, max_boot_clusters, valid)
+        return m
+
+    mats = jax.vmap(per_boot)(jnp.asarray(boot_labels, jnp.int32))     # [B, C, C]
+    mean = jnp.nanmean(mats, axis=0)
+    mean = jnp.where(jnp.isnan(mean), 1.0, mean)
+    c = mean.shape[0]
+    return mean.at[jnp.arange(c), jnp.arange(c)].set(
+        jnp.where(jnp.isnan(jnp.diagonal(mean)), 1.0, jnp.diagonal(mean))
+    )
+
+
+def merge_unstable_clusters(
+    consensus: np.ndarray,
+    boot_labels: np.ndarray,
+    min_stability: float,
+    max_clusters: int,
+) -> np.ndarray:
+    """Host loop over the tiny stability matrix (reference :489-495): while
+    the off-diagnoal/diagonal minimum over occupied clusters is below
+    `min_stability`, relabel the offending pair as one cluster in the
+    consensus AND the bootstrap assignments (both sides, as the reference
+    does), then recompute."""
+    consensus = np.asarray(consensus, np.int32).copy()
+    boot_labels = np.asarray(boot_labels, np.int32).copy()
+    while True:
+        ids = np.unique(consensus)
+        if len(ids) <= 1:
+            return consensus
+        occupied = np.zeros(max_clusters, bool)
+        occupied[ids] = True
+        sm = np.asarray(
+            stability_matrix(consensus, boot_labels, max_clusters)
+        )
+        sm_occ = sm[np.ix_(occupied, occupied)]
+        if np.min(sm_occ) >= min_stability:
+            return consensus
+        flat = int(np.argmin(sm_occ))
+        a, b = np.divmod(flat, sm_occ.shape[1])
+        ca, cb = ids[a], ids[b]
+        if ca == cb:
+            # an unstable diagonal: the cluster itself is not reproducible;
+            # merge it into its most-confused partner (row argmin off-diag)
+            row = sm[ca].copy()
+            row[ca] = np.inf
+            row[~occupied] = np.inf
+            cb = int(np.argmin(row))
+        lo, hi = min(ca, cb), max(ca, cb)
+        consensus[consensus == hi] = lo
+        # merging inside boot labels: only cluster ids of the *consensus*
+        # labelling are merged there in the reference; boot labels use their
+        # own id space, so only the consensus side changes here (the Rand
+        # contingency handles the rest)
+        return merge_unstable_clusters(consensus, boot_labels, min_stability, max_clusters)

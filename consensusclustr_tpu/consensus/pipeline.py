@@ -1,0 +1,258 @@
+"""The consensus layer driver (L5): bootstrap fan-out, co-clustering distance,
+consensus re-clustering, merges.
+
+Mirrors reference R/consensusClust.R:388-511 (SURVEY §3.1):
+
+  bootstrap fan-out (:391-400)      -> vmapped cluster_grid over [B, m] gathers
+  assignment matrix + NA->-1 (:404) -> int32 [B, n] with -1 masks
+  C++ Jaccard + parDist (:411-421)  -> one batched einsum/Pallas pass
+  consensus clustering (:423-441)   -> knn_from_distance -> SNN -> Leiden grid
+  silhouette ranking on PCA (:445)  -> candidate_score(singleton_floor=True)
+  small-cluster merge (:461-467)    -> merge_small_clusters on Jaccard dists
+  stability merge (:469-497)        -> merge_unstable_clusters
+  no-bootstrap path (:498-511)      -> single grid + Euclidean small-merge
+
+Per-bootstrap failure semantics (reference :392-399 tryCatch -> all-ones): the
+batched kernels cannot raise per boot; degenerate resamples produce the
+single-cluster labelling naturally (scored 0), which is the same statistical
+fallback (SURVEY §5 failure-detection row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.cluster.engine import align_to_cells, cluster_grid
+from consensusclustr_tpu.cluster.knn import knn_from_distance
+from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
+from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+from consensusclustr_tpu.cluster.engine import candidate_score
+from consensusclustr_tpu.cluster.snn import snn_graph
+from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
+from consensusclustr_tpu.consensus.cocluster import coclustering_distance
+from consensusclustr_tpu.consensus.merge import (
+    merge_small_clusters,
+    merge_unstable_clusters,
+)
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import cluster_key
+
+
+class ConsensusResult(NamedTuple):
+    labels: np.ndarray                 # [n] compact consensus labels
+    silhouette: float                  # mean approx-silhouette of labels on PCA
+    jaccard_dist: Optional[np.ndarray]  # [n, n] co-clustering distance (None if nboots<=1)
+    boot_labels: Optional[np.ndarray]   # [B(,*K*R), n] aligned boot assignments
+    n_clusters: int
+
+
+def _ties_last_argmax(scores: jax.Array) -> jax.Array:
+    r = scores.shape[0]
+    return (r - 1 - jnp.argmax(scores[::-1])).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells"),
+)
+def _boot_batch(
+    keys: jax.Array,          # [chunk]
+    idx: jax.Array,           # [chunk, m]
+    pca: jax.Array,           # [n, d]
+    res_list: jax.Array,      # [R]
+    k_list,
+    min_size: jax.Array,
+    n_res: int,
+    max_clusters: int,
+    n_iters: int,
+    robust: bool,
+    n_cells: int,
+):
+    """One jitted chunk of bootstraps: gather -> grid -> select -> align."""
+
+    def one(key_b, idx_b):
+        x = pca[idx_b]
+        grid = cluster_grid(
+            key_b, x, res_list, k_list, min_size,
+            max_clusters=max_clusters, n_iters=n_iters,
+        )
+        if robust:
+            best = _ties_last_argmax(grid.scores)
+            labels = grid.labels[best]                       # [m]
+            aligned = align_to_cells(labels, idx_b, n_cells)  # [n]
+            return aligned, grid.scores[best]
+        aligned = align_to_cells(grid.labels, idx_b, n_cells)  # [n_cand, n]
+        return aligned, grid.scores
+
+    return jax.vmap(one)(keys, idx)
+
+
+def _auto_boot_chunk(
+    n: int, m: int, nboots: int, requested: int, n_res: int, k_max: int
+) -> int:
+    if requested > 0:
+        return max(1, min(requested, nboots))
+    # Bound the per-chunk workspace: the kNN m x m distance pass plus the
+    # Leiden local-move gain tensor [n_res, m, e, e+2] (e = 2k edge slots).
+    # The axon TPU runtime hard-crashes (not OOMs gracefully) when pushed, so
+    # stay well under HBM: ~256 MB of tracked workspace per chunk.
+    e = 2 * k_max
+    per_boot = m * m * 4.0 + n_res * m * e * (e + 2) * 4.0
+    budget = 2.5e8
+    return int(max(1, min(nboots, budget // max(per_boot, 1.0), 32)))
+
+
+def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None):
+    """All bootstrap clusterings, chunked over the boot axis.
+
+    Returns (boot_labels [B_eff, n] int32 with -1 for unsampled, scores).
+    In granular mode B_eff = nboots * |k_num| * |res_range| (reference keeps
+    every candidate, :688).
+    """
+    n, _ = pca.shape
+    m = max(2, int(round(cfg.boot_size * n)))
+    idx = bootstrap_indices(key, n, cfg.nboots, m)
+    res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
+    k_list = tuple(int(k) for k in cfg.k_num)
+    robust = cfg.mode == "robust"
+    chunk = _auto_boot_chunk(
+        n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list)
+    )
+
+    keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
+    out_labels, out_scores = [], []
+    for s in range(0, cfg.nboots, chunk):
+        e = min(s + chunk, cfg.nboots)
+        labels, scores = _boot_batch(
+            keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
+            jnp.asarray(float(cfg.min_size), jnp.float32),
+            len(cfg.res_range), cfg.max_clusters, 20, robust, n,
+        )
+        out_labels.append(np.asarray(labels))
+        out_scores.append(np.asarray(scores))
+        if log:
+            log.event("boots", done=e, total=cfg.nboots)
+    labels = np.concatenate(out_labels, axis=0)
+    scores = np.concatenate(out_scores, axis=0)
+    if not robust:
+        labels = labels.reshape(-1, n)                      # [B*K*R, n]
+        scores = scores.reshape(-1)
+    return labels, scores
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k_list", "max_clusters", "n_iters")
+)
+def _consensus_grid(
+    key: jax.Array,
+    dist: jax.Array,     # [n, n] jaccard distance
+    pca: jax.Array,      # [n, d] for silhouette ranking
+    res_list: jax.Array,
+    k_list,
+    min_size: jax.Array,
+    max_clusters: int,
+    n_iters: int = 20,
+):
+    """Consensus re-clustering (reference :423-441): kNN on the distance
+    matrix per k, SNN, Leiden per resolution; rank by PCA silhouette with the
+    all-singletons -> -1 floor (:445-453)."""
+    r = res_list.shape[0]
+    all_labels, all_scores = [], []
+    for ki, k in enumerate(k_list):
+        idx, _ = knn_from_distance(dist, k)
+        graph = snn_graph(idx)
+        keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r))
+
+        def one_res(kk, res):
+            raw = leiden_fixed(kk, graph, res, n_iters=n_iters)
+            compact, n_c, overflow = compact_labels(raw, max_clusters)
+            score = candidate_score(
+                pca, compact, n_c, overflow, min_size, max_clusters,
+                singleton_floor=True,
+            )
+            return compact, score
+
+        labels_k, scores_k = jax.vmap(one_res)(keys, res_list)
+        all_labels.append(labels_k)
+        all_scores.append(scores_k)
+    labels = jnp.concatenate(all_labels, axis=0)
+    scores = jnp.concatenate(all_scores, axis=0)
+    best = _ties_last_argmax(scores)
+    return labels[best], scores
+
+
+def consensus_cluster(
+    key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None
+) -> ConsensusResult:
+    """Full L5: reference :388-511."""
+    pca = jnp.asarray(pca, jnp.float32)
+    n = pca.shape[0]
+    res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
+    k_list = tuple(int(k) for k in cfg.k_num)
+    min_size_cluster = jnp.asarray(float(cfg.min_size), jnp.float32)
+
+    if cfg.nboots <= 1:
+        # no-bootstrap path (reference :498-511)
+        grid = cluster_grid(
+            key, pca, res_list, k_list, min_size_cluster,
+            max_clusters=cfg.max_clusters,
+        )
+        best = int(_ties_last_argmax(grid.scores))
+        labels = np.asarray(grid.labels[best])
+        # Euclidean distances for the small-cluster merge (:504-510)
+        d2 = np.asarray(
+            jnp.sqrt(jnp.maximum(
+                jnp.sum(pca**2, 1)[:, None] - 2 * pca @ pca.T + jnp.sum(pca**2, 1)[None, :],
+                0.0,
+            ))
+        )
+        labels = merge_small_clusters(d2, labels, max(k_list[0], 30), cfg.max_clusters)
+        sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
+        if log:
+            log.event("no_boot_result", n_clusters=len(np.unique(labels)), silhouette=sil)
+        return ConsensusResult(
+            labels=labels, silhouette=sil, jaccard_dist=None, boot_labels=None,
+            n_clusters=len(np.unique(labels)),
+        )
+
+    boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log)
+    dist = coclustering_distance(
+        jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters
+    )
+    cons_labels, cons_scores = _consensus_grid(
+        key, dist, pca, res_list, k_list, min_size_cluster, cfg.max_clusters
+    )
+    labels = np.asarray(cons_labels)
+    dist_np = np.asarray(dist)
+    if log:
+        log.event(
+            "consensus", n_clusters=len(np.unique(labels)),
+            best_score=float(np.max(np.asarray(cons_scores))),
+        )
+
+    # small-cluster merge on co-clustering distances (:461-467)
+    labels = merge_small_clusters(
+        dist_np, labels, max(k_list[0], 20), cfg.max_clusters
+    )
+    # stability merge against the per-boot assignments (:469-497)
+    labels = merge_unstable_clusters(
+        labels, boot_labels, cfg.min_stability, cfg.max_clusters
+    )
+    sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
+    if log:
+        log.event(
+            "merged", n_clusters=len(np.unique(labels)), silhouette=sil,
+        )
+    return ConsensusResult(
+        labels=labels,
+        silhouette=sil,
+        jaccard_dist=dist_np,
+        boot_labels=boot_labels,
+        n_clusters=len(np.unique(labels)),
+    )

@@ -170,6 +170,10 @@ def pca_for_config(
             else:
                 sdev_u = res.sdev
             chosen = denoised_pc_num(x_norm, counts, size_factors, sdev_u)
+            if chosen > 30:
+                # the reference's :338 numeric>30 override also swallows the
+                # getDenoisedPCs result (quirks item 3) — replicate
+                chosen = choose_pc_num(res.sdev, pc_var)
         else:
             chosen = choose_pc_num(res.sdev, pc_var)
         chosen = min(chosen, k50)

@@ -50,6 +50,8 @@ def poisson_deviance(counts: jax.Array) -> jax.Array:
 def select_hvgs(counts: jax.Array, n_var_features: int = 2000, family: str = "binomial") -> jax.Array:
     """Boolean mask of the top-`n_var_features` genes by deviance
     (reference R/consensusClust.R:295-299)."""
+    if family not in ("binomial", "poisson"):
+        raise ValueError(f"family must be 'binomial' or 'poisson'; got {family!r}")
     dev = binomial_deviance(counts) if family == "binomial" else poisson_deviance(counts)
     g = dev.shape[0]
     k = min(int(n_var_features), g)

@@ -29,9 +29,16 @@ _MAX_RATIO_GENES = 4096  # cap genes used for pool median ratios (memory bound)
 
 
 def libsize_factors(counts: jax.Array) -> jax.Array:
-    """Library-size factors, scaled to unit mean."""
+    """Library-size factors, scaled to unit mean.
+
+    All-zero cells get factor 1 (their normalised row is all-zero either way);
+    a zero factor would turn shifted_log's x/sf into 0/0 NaNs.
+    """
     lib = jnp.sum(counts, axis=1)
-    return lib / jnp.maximum(jnp.mean(lib), 1e-12)
+    pos = lib > 0
+    mean_pos = jnp.sum(jnp.where(pos, lib, 0.0)) / jnp.maximum(jnp.sum(pos), 1.0)
+    sf = lib / jnp.maximum(mean_pos, 1e-12)
+    return jnp.where(pos, sf, 1.0)
 
 
 def _ring_window_sum(x: jax.Array, size: int) -> jax.Array:

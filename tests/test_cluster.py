@@ -1,0 +1,268 @@
+"""Clustering-engine tests: kNN/SNN correctness, Leiden quality parity,
+metric oracles (sklearn), engine grid behavior, bootstrap alignment
+(SURVEY §4 items 1-2)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from sklearn.metrics import adjusted_rand_score, silhouette_score
+
+from consensusclustr_tpu.cluster import (
+    knn_points,
+    knn_from_distance,
+    snn_graph,
+    leiden_fixed,
+    compact_labels,
+    approx_silhouette,
+    mean_silhouette_score,
+    pairwise_rand,
+    cluster_grid,
+    get_clust_assignments,
+)
+from consensusclustr_tpu.cluster.leiden import modularity
+from consensusclustr_tpu.cluster.engine import align_to_cells, first_occurrence
+from tests.conftest import make_blobs
+
+
+# ---------- kNN ----------
+
+def test_knn_matches_bruteforce_numpy(rng):
+    x = rng.normal(size=(50, 4)).astype(np.float32)
+    idx, dist = knn_points(x, 5)
+    idx, dist = np.asarray(idx), np.asarray(dist)
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    for i in range(50):
+        expected = set(np.argsort(d2[i])[:5])
+        assert set(idx[i]) == expected
+        np.testing.assert_allclose(np.sort(dist[i]), np.sort(np.sqrt(d2[i][list(expected)])), rtol=1e-4)
+
+
+def test_knn_from_distance_matrix(rng):
+    d = rng.uniform(size=(20, 20)).astype(np.float32)
+    d = (d + d.T) / 2
+    idx, dv = knn_from_distance(d, 3)
+    d2 = d.copy()
+    np.fill_diagonal(d2, np.inf)
+    for i in range(20):
+        assert set(np.asarray(idx[i])) == set(np.argsort(d2[i])[:3])
+
+
+# ---------- SNN ----------
+
+def test_snn_rank_weights_small_case():
+    # 4 points on a line: 0-1 close, 2-3 close, pairs far apart
+    x = np.array([[0.0], [0.1], [10.0], [10.1]], np.float32)
+    idx, _ = knn_points(x, 2)
+    g = snn_graph(idx)
+    w = np.asarray(g.w)
+    nbr = np.asarray(g.nbr)
+    # edge 0->1: shared neighbour 1 itself: rank_0(1)=1, rank_1(1)=0 -> r=1
+    # weight = k - r/2 = 2 - 0.5 = 1.5
+    a = int(np.where(nbr[0, :2] == 1)[0][0])
+    assert w[0, a] == pytest.approx(1.5)
+    # symmetric total degree
+    assert np.asarray(g.two_m) == pytest.approx(np.asarray(g.deg).sum())
+
+
+def test_snn_no_double_counted_mutual_edges():
+    x = np.array([[0.0], [0.1], [0.2], [5.0], [5.1], [5.2]], np.float32)
+    idx, _ = knn_points(x, 2)
+    g = snn_graph(idx)
+    nbr, w = np.asarray(g.nbr), np.asarray(g.w)
+    # total weight on each undirected pair must be counted exactly twice
+    # (once per endpoint) in the slot representation
+    pair_w = {}
+    for i in range(6):
+        for a in range(nbr.shape[1]):
+            j = nbr[i, a]
+            if w[i, a] > 0:
+                pair_w.setdefault(tuple(sorted((i, int(j)))), []).append(w[i, a])
+    for pair, ws in pair_w.items():
+        assert len(ws) == 2, f"pair {pair} counted {len(ws)} times"
+        assert ws[0] == pytest.approx(ws[1])
+
+
+# ---------- Leiden ----------
+
+def _two_clique_graph():
+    """Two 6-cliques joined by one bridge edge — unambiguous communities."""
+    n = 12
+    x = np.zeros((n, 2), np.float32)
+    x[:6] = np.random.default_rng(0).normal(0, 0.1, (6, 2))
+    x[6:] = np.random.default_rng(1).normal(5, 0.1, (6, 2)) + 20
+    return x
+
+
+def test_leiden_recovers_planted_blobs():
+    x, truth = make_blobs(n_per=50, n_genes=8, n_clusters=3, sep=8.0, seed=2)
+    idx, _ = knn_points(jnp.asarray(x), 10)
+    g = snn_graph(idx)
+    labels = leiden_fixed(jax.random.key(0), g, 0.5)
+    compact, n_c, overflow = compact_labels(labels, 64)
+    ari = adjusted_rand_score(truth, np.asarray(compact))
+    assert not bool(overflow)
+    assert ari > 0.98, f"ARI={ari}, n_clusters={int(n_c)}"
+
+
+def test_leiden_modularity_near_greedy_oracle():
+    # quality parity: our fixed-iteration variant must reach >= 95% of the
+    # modularity found by an exhaustive-ish greedy CPU oracle on a small graph
+    x, truth = make_blobs(n_per=30, n_genes=6, n_clusters=3, sep=6.0, seed=3)
+    idx, _ = knn_points(jnp.asarray(x), 8)
+    g = snn_graph(idx)
+    labels = leiden_fixed(jax.random.key(1), g, 1.0)
+    q_ours = float(modularity(g, labels, 1.0))
+    q_truth = float(modularity(g, jnp.asarray(truth), 1.0))
+    assert q_ours >= 0.95 * q_truth, (q_ours, q_truth)
+
+
+def test_leiden_resolution_monotone_cluster_count():
+    x, _ = make_blobs(n_per=40, n_genes=6, n_clusters=4, sep=5.0, seed=4)
+    idx, _ = knn_points(jnp.asarray(x), 10)
+    g = snn_graph(idx)
+    ncs = []
+    for res in (0.05, 1.0, 8.0):
+        labels = leiden_fixed(jax.random.key(2), g, res)
+        _, n_c, _ = compact_labels(labels, 160)
+        ncs.append(int(n_c))
+    assert ncs[0] <= ncs[1] <= ncs[2]
+    assert ncs[2] > ncs[0]  # resolution does something
+
+
+def test_leiden_deterministic_given_key():
+    x, _ = make_blobs(n_per=30, n_genes=5, seed=5)
+    idx, _ = knn_points(jnp.asarray(x), 8)
+    g = snn_graph(idx)
+    l1 = np.asarray(leiden_fixed(jax.random.key(7), g, 0.8))
+    l2 = np.asarray(leiden_fixed(jax.random.key(7), g, 0.8))
+    np.testing.assert_array_equal(l1, l2)
+
+
+# ---------- metrics ----------
+
+def test_approx_silhouette_tracks_sklearn():
+    x, truth = make_blobs(n_per=40, n_genes=5, n_clusters=3, sep=6.0, seed=6)
+    ours = float(mean_silhouette_score(jnp.asarray(x), jnp.asarray(truth), 8))
+    skl = silhouette_score(x, truth)
+    # approx (centroid) silhouette is not exact silhouette, but on separated
+    # blobs both are high and close
+    assert abs(ours - skl) < 0.15
+    assert ours > 0.5
+
+    # permuted labels -> silhouette near 0
+    perm = np.random.default_rng(0).permutation(truth)
+    ours_perm = float(mean_silhouette_score(jnp.asarray(x), jnp.asarray(perm), 8))
+    assert ours_perm < 0.1
+
+
+def test_silhouette_respects_valid_mask():
+    x, truth = make_blobs(n_per=20, n_genes=4, n_clusters=2, sep=6.0, seed=7)
+    valid = np.ones(len(truth), bool)
+    valid[:5] = False
+    s = approx_silhouette(jnp.asarray(x), jnp.asarray(truth), 4, jnp.asarray(valid))
+    assert np.all(np.asarray(s)[:5] == 0.0)
+
+
+def test_pairwise_rand_identical_clusterings():
+    labels = np.array([0] * 10 + [1] * 10 + [2] * 10)
+    m = np.asarray(pairwise_rand(labels, labels, 4, 4))
+    # occupied diagonal == 1 (perfect within-cluster concordance)
+    for c in range(3):
+        assert m[c, c] == pytest.approx(1.0, abs=1e-5)
+    # occupied off-diagonals == 1 (pairs kept apart)
+    assert m[0, 1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pairwise_rand_merged_in_alt():
+    ref = np.array([0] * 10 + [1] * 10)
+    alt = np.zeros(20, np.int32)  # alt merges everything: chance rate s = 1
+    m = np.asarray(pairwise_rand(ref, alt, 3, 3))
+    # cross pairs never separated; with s=1 the adjusted score is exactly
+    # chance level (0), not negative — the degenerate-alt corner
+    assert m[0, 1] == pytest.approx(0.0, abs=1e-5)
+    assert np.isfinite(m[0, 1])
+
+
+def test_pairwise_rand_partial_disagreement_scores_between():
+    r = np.random.default_rng(0)
+    ref = np.repeat([0, 1, 2], 30)
+    alt = ref.copy()
+    flip = r.choice(90, size=20, replace=False)
+    alt[flip] = r.integers(0, 3, size=20)  # 20 cells scrambled
+    m = np.asarray(pairwise_rand(ref, alt, 4, 4))
+    for c in range(3):
+        assert 0.3 < m[c, c] < 1.0  # degraded but above chance
+    assert 0.3 < m[0, 1] <= 1.0
+
+
+def test_pairwise_rand_respects_mask():
+    ref = np.array([0] * 10 + [1] * 10)
+    alt = ref.copy()
+    alt[:5] = 1  # disagreement only in masked-out region
+    valid = np.ones(20, bool)
+    valid[:5] = False
+    m = np.asarray(pairwise_rand(ref, alt, 3, 3, jnp.asarray(valid)))
+    assert m[0, 0] == pytest.approx(1.0, abs=1e-5)
+
+
+# ---------- engine ----------
+
+def test_cluster_grid_shapes_and_scores():
+    x, truth = make_blobs(n_per=40, n_genes=6, n_clusters=3, sep=7.0, seed=8)
+    res = cluster_grid(
+        jax.random.key(0),
+        jnp.asarray(x),
+        jnp.asarray([0.1, 0.5, 1.0], jnp.float32),
+        (8, 12),
+        jnp.asarray(5.0),
+        max_clusters=32,
+    )
+    assert res.labels.shape == (6, 120)
+    assert res.scores.shape == (6,)
+    best = int(np.argmax(np.asarray(res.scores)))
+    ari = adjusted_rand_score(truth, np.asarray(res.labels[best]))
+    assert ari > 0.95
+
+
+def test_get_clust_assignments_robust_mode():
+    x, truth = make_blobs(n_per=40, n_genes=6, n_clusters=3, sep=7.0, seed=9)
+    labels, score = get_clust_assignments(
+        x, res_range=[0.1, 0.5, 1.0], k_num=(10,), min_size=5, seed=1
+    )
+    assert labels.shape == (120,)
+    assert adjusted_rand_score(truth, labels) > 0.95
+    assert score > 0.3
+
+
+def test_get_clust_assignments_granular_mode():
+    x, _ = make_blobs(n_per=30, n_genes=5, n_clusters=2, sep=6.0, seed=10)
+    out = get_clust_assignments(
+        x, res_range=[0.2, 0.8], k_num=(6, 8), mode="granular", min_size=5
+    )
+    assert out.shape == (4, 60)
+
+
+# ---------- bootstrap alignment (quirk 14 semantics) ----------
+
+def test_first_occurrence_and_alignment():
+    boot_idx = np.array([3, 1, 3, 0, 1], np.int32)  # cells 2,4 unsampled; 1,3 duplicated
+    first = np.asarray(first_occurrence(jnp.asarray(boot_idx), 5))
+    np.testing.assert_array_equal(first, [3, 1, 5, 0, 5])
+    labels = jnp.asarray([10, 11, 12, 13, 14], jnp.int32)  # per boot row
+    aligned = np.asarray(align_to_cells(labels, jnp.asarray(boot_idx), 5))
+    # cell 0 <- row 3 (13); cell 1 <- row 1 (11, first copy); cell 2 -> -1;
+    # cell 3 <- row 0 (10, first copy); cell 4 -> -1
+    np.testing.assert_array_equal(aligned, [13, 11, -1, 10, -1])
+
+
+def test_candidate_selection_prefers_good_clustering():
+    # a resolution sweep must not pick the all-one-cluster candidate when
+    # structure exists (score 0 < silhouette of real split)
+    x, truth = make_blobs(n_per=50, n_genes=6, n_clusters=2, sep=8.0, seed=11)
+    labels, score = get_clust_assignments(
+        x, res_range=[0.01, 0.6], k_num=(10,), min_size=5, seed=3
+    )
+    assert len(np.unique(labels)) >= 2
+    assert adjusted_rand_score(truth, labels) > 0.95

@@ -1,0 +1,159 @@
+"""Consensus-layer tests: bootstrap masks, co-clustering distance oracle,
+merge loops, and the end-to-end slice on planted blobs (SURVEY §4 items 2-3)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus import (
+    bootstrap_indices,
+    sampled_mask,
+    coclustering_distance,
+    cluster_mean_distance,
+    merge_small_clusters,
+    stability_matrix,
+    merge_unstable_clusters,
+    consensus_cluster,
+)
+from consensusclustr_tpu.utils.rng import root_key
+from tests.conftest import make_blobs
+
+
+def test_bootstrap_indices_deterministic_and_in_range():
+    k = root_key(7)
+    idx1 = np.asarray(bootstrap_indices(k, 100, 5, 90))
+    idx2 = np.asarray(bootstrap_indices(k, 100, 5, 90))
+    np.testing.assert_array_equal(idx1, idx2)
+    assert idx1.shape == (5, 90)
+    assert idx1.min() >= 0 and idx1.max() < 100
+    # boots differ from each other
+    assert not np.array_equal(idx1[0], idx1[1])
+
+
+def test_sampled_mask_matches_indices():
+    idx = jnp.asarray([[0, 0, 2], [1, 3, 3]], jnp.int32)
+    mask = np.asarray(sampled_mask(idx, 5))
+    np.testing.assert_array_equal(
+        mask, [[True, False, True, False, False], [False, True, False, True, False]]
+    )
+
+
+def test_coclustering_distance_oracle():
+    # hand-checkable case + full numpy oracle
+    labels = np.array(
+        [
+            [0, 0, 1, 1, -1],
+            [0, 1, 1, 0, 0],
+            [-1, 0, 0, 0, 1],
+        ],
+        np.int32,
+    )
+    d = np.asarray(coclustering_distance(jnp.asarray(labels), max_clusters=4, chunk=2))
+    b, n = labels.shape
+    exp = np.zeros((n, n))
+    for i in range(n):
+        for j in range(n):
+            both = (labels[:, i] >= 0) & (labels[:, j] >= 0)
+            agree = np.sum((labels[:, i] == labels[:, j]) & both)
+            union = np.sum(both)
+            exp[i, j] = 1.0 - (agree / union if union else 0.0)
+    np.fill_diagonal(exp, 0.0)
+    np.testing.assert_allclose(d, exp, atol=1e-5)
+
+
+def test_coclustering_distance_never_cosampled():
+    labels = np.array([[0, -1], [0, -1], [-1, 0]], np.int32)
+    d = np.asarray(coclustering_distance(jnp.asarray(labels), max_clusters=2))
+    assert d[0, 1] == pytest.approx(1.0)  # union 0 -> distance 1, not NaN
+    assert np.all(np.isfinite(d))
+
+
+def test_cluster_mean_distance_and_small_merge():
+    # 3 groups in 1-D; group 2 tiny and nearest to group 1
+    x = np.array([0.0, 0.1, 0.2, 5.0, 5.1, 5.2, 6.0], np.float32)[:, None]
+    d = np.abs(x - x.T)
+    labels = np.array([0, 0, 0, 1, 1, 1, 2], np.int32)
+    cd = np.asarray(cluster_mean_distance(jnp.asarray(d), jnp.asarray(labels), 4))
+    assert cd[0, 1] == pytest.approx(np.mean(np.abs(x[:3] - x[3:6].T)), rel=1e-4)
+    assert np.isinf(cd[0, 3])  # empty cluster
+    merged = merge_small_clusters(d, labels, min_size=2, max_clusters=4)
+    # singleton cluster 2 absorbed into nearest (cluster 1)
+    np.testing.assert_array_equal(merged, [0, 0, 0, 1, 1, 1, 1])
+
+
+def test_stability_matrix_stable_case():
+    cons = np.repeat([0, 1], 20).astype(np.int32)
+    # bootstraps agree perfectly (modulo own label names)
+    boots = np.stack([np.repeat([3, 5], 20), np.repeat([1, 0], 20)]).astype(np.int32)
+    sm = np.asarray(stability_matrix(jnp.asarray(cons), jnp.asarray(boots), 4))
+    assert sm[0, 0] == pytest.approx(1.0, abs=1e-5)
+    assert sm[0, 1] == pytest.approx(1.0, abs=1e-5)
+    merged = merge_unstable_clusters(cons, boots, 0.175, 4)
+    assert len(np.unique(merged)) == 2  # nothing merged
+
+
+def test_merge_unstable_clusters_collapses_noise_split():
+    # consensus splits 40 cells into 2, but bootstraps shuffle membership
+    r = np.random.default_rng(0)
+    cons = np.repeat([0, 1], 20).astype(np.int32)
+    boots = np.stack([r.integers(0, 2, 40) for _ in range(6)]).astype(np.int32)
+    merged = merge_unstable_clusters(cons, boots, 0.175, 4)
+    assert len(np.unique(merged)) == 1
+
+
+def _small_cfg(**kw):
+    base = dict(
+        nboots=8,
+        res_range=(0.1, 0.5, 1.0),
+        k_num=(10,),
+        min_size=5,
+        max_clusters=32,
+        seed=5,
+    )
+    base.update(kw)
+    return ClusterConfig(**base)
+
+
+def test_consensus_cluster_end_to_end_blobs():
+    x, truth = make_blobs(n_per=40, n_genes=6, n_clusters=3, sep=7.0, seed=12)
+    cfg = _small_cfg()
+    res = consensus_cluster(root_key(cfg.seed), x, cfg)
+    assert res.labels.shape == (120,)
+    assert res.n_clusters == 3
+    ari = adjusted_rand_score(truth, res.labels)
+    assert ari > 0.95, ari
+    assert res.silhouette > 0.3
+    assert res.jaccard_dist.shape == (120, 120)
+    # co-clustering distance is small within true clusters, large across
+    within = res.jaccard_dist[:40, :40][np.triu_indices(40, 1)].mean()
+    across = res.jaccard_dist[:40, 40:80].mean()
+    assert within < 0.2 < across
+
+
+def test_consensus_cluster_no_boot_path():
+    x, truth = make_blobs(n_per=40, n_genes=6, n_clusters=2, sep=7.0, seed=13)
+    cfg = _small_cfg(nboots=0)
+    res = consensus_cluster(root_key(1), x, cfg)
+    assert res.jaccard_dist is None
+    assert adjusted_rand_score(truth, res.labels) > 0.95
+
+
+def test_consensus_cluster_granular_mode():
+    x, truth = make_blobs(n_per=30, n_genes=5, n_clusters=2, sep=7.0, seed=14)
+    cfg = _small_cfg(mode="granular", nboots=4, res_range=(0.2, 0.8))
+    res = consensus_cluster(root_key(2), x, cfg)
+    # granular: every candidate is a consensus column
+    assert res.boot_labels.shape == (4 * 1 * 2, 60)
+    assert adjusted_rand_score(truth, res.labels) > 0.9
+
+
+def test_consensus_deterministic_across_chunk_sizes():
+    # golden-run determinism: same seed => identical assignments regardless of
+    # how the boot axis is chunked (SURVEY §4 item 5)
+    x, _ = make_blobs(n_per=30, n_genes=5, n_clusters=2, sep=6.0, seed=15)
+    r1 = consensus_cluster(root_key(3), x, _small_cfg(boot_batch=2))
+    r2 = consensus_cluster(root_key(3), x, _small_cfg(boot_batch=8))
+    np.testing.assert_array_equal(r1.labels, r2.labels)

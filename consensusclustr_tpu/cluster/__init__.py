@@ -2,4 +2,9 @@ from consensusclustr_tpu.cluster.knn import knn_points, knn_from_distance
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
 from consensusclustr_tpu.cluster.metrics import approx_silhouette, mean_silhouette_score, pairwise_rand
-from consensusclustr_tpu.cluster.engine import cluster_grid, get_clust_assignments, candidate_score
+from consensusclustr_tpu.cluster.engine import (
+    cluster_grid,
+    get_clust_assignments,
+    candidate_score,
+    consensus_candidate_score,
+)

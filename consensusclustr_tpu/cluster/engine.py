@@ -36,7 +36,7 @@ class GridResult(NamedTuple):
     scores: jax.Array      # [n_cand] float32
 
 
-@functools.partial(jax.jit, static_argnames=("max_clusters", "singleton_floor"))
+@functools.partial(jax.jit, static_argnames=("max_clusters",))
 def candidate_score(
     x: jax.Array,
     labels: jax.Array,
@@ -44,33 +44,50 @@ def candidate_score(
     overflow: jax.Array,
     min_size: jax.Array,
     max_clusters: int,
-    singleton_floor: bool = False,
 ) -> jax.Array:
-    """Reference scoring rules (:662-669 boot path, :445-453 consensus path):
+    """getClustAssignments robust-mode scoring (reference :662-669):
 
-      * all clusters singletons        -> -1    (consensus path only)
-      * any cluster size <= min_size   -> 0.15
+      * any cluster size <= min_size   -> 0.15  (inert at the reference's
+        default minSize=0 — only the null sims pass minSize=5, :803-804)
       * single cluster (sizes ok)      -> 0
       * otherwise                      -> mean approx-silhouette
-      * > max_clusters communities     -> 0.15 (fragmentation == small clusters)
+      * > max_clusters communities     -> 0.15 (padding overflow; the labels
+        are unusable, treat as fragmentation)
     """
-    n = labels.shape[0]
     counts = jnp.zeros((max_clusters,), jnp.float32).at[labels].add(1.0)
     occupied = counts > 0
     min_count = jnp.min(jnp.where(occupied, counts, jnp.inf))
     any_small = (min_count <= min_size) | overflow
     single = n_clusters <= 1
     sil = mean_silhouette_score(x, labels, max_clusters)
-    score = jnp.where(any_small, 0.15, jnp.where(single, 0.0, sil))
-    if singleton_floor:
-        all_singleton = n_clusters >= n
-        score = jnp.where(all_singleton, -1.0, score)
-    return score
+    return jnp.where(any_small, 0.15, jnp.where(single, 0.0, sil))
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters",))
+def consensus_candidate_score(
+    x: jax.Array,
+    labels: jax.Array,
+    n_clusters: jax.Array,
+    overflow: jax.Array,
+    max_clusters: int,
+) -> jax.Array:
+    """Consensus-path scoring (reference :445-453), which differs from the
+    boot path:
+
+      * 1 < C < n/10                   -> mean approx-silhouette
+      * all clusters singletons (C==n) -> -1
+      * everything else (incl. C==1)   -> 0.15
+    """
+    n = labels.shape[0]
+    sil = mean_silhouette_score(x, labels, max_clusters)
+    informative = (n_clusters > 1) & (n_clusters < n / 10.0) & ~overflow
+    all_singleton = n_clusters >= n
+    return jnp.where(informative, sil, jnp.where(all_singleton, -1.0, 0.15))
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac", "singleton_floor"),
+    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac"),
 )
 def cluster_grid(
     key: jax.Array,
@@ -81,7 +98,6 @@ def cluster_grid(
     max_clusters: int = 64,
     n_iters: int = 20,
     update_frac: float = 0.5,
-    singleton_floor: bool = False,
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set.
 
@@ -102,9 +118,7 @@ def cluster_grid(
         def one_res(kk, res):
             raw = leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
             compact, n_c, overflow = compact_labels(raw, max_clusters)
-            score = candidate_score(
-                x, compact, n_c, overflow, min_size, max_clusters, singleton_floor
-            )
+            score = candidate_score(x, compact, n_c, overflow, min_size, max_clusters)
             return compact, n_c, score
 
         labels_k, nc_k, scores_k = jax.vmap(one_res)(keys, res_list)
@@ -151,7 +165,7 @@ def get_clust_assignments(
     k_num: Sequence[int] = (10, 15, 20),
     mode: str = "robust",
     seed: int = 123,
-    min_size: int = 50,
+    min_size: int = 0,
     boot_idx: Optional[np.ndarray] = None,
     n_cells: Optional[int] = None,
     max_clusters: int = 64,
@@ -162,9 +176,12 @@ def get_clust_assignments(
 
     pca: [m, d] embedding (possibly a bootstrap slice). When `boot_idx` and
     `n_cells` are given, output is aligned to the original cells with -1 for
-    unsampled ones. Returns (labels, score) in "robust" mode (argmax
-    silhouette candidate, ties to the last as in the reference's
-    ties.method="last") or a [n_cand, n] label matrix in "granular" mode.
+    unsampled ones. Returns (labels, score) in "robust" mode or a [n_cand, n]
+    label matrix in "granular" mode. Robust-mode ties go to the LAST tied
+    candidate: the reference ranks with ties.method="first" (:685), under
+    which the maximum rank lands on the last occurrence of the max score.
+    min_size defaults to 0 as in the reference (:650), where the 0.15 floor is
+    inert for the main pipeline and only the null sims pass minSize=5.
 
     `cluster_fun` selects leiden/louvain; both map to the fixed-iteration
     masked local-move kernel (docs/quirks.md D2/item 6).

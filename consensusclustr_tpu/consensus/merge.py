@@ -108,39 +108,36 @@ def merge_unstable_clusters(
     min_stability: float,
     max_clusters: int,
 ) -> np.ndarray:
-    """Host loop over the tiny stability matrix (reference :489-495): while
-    the off-diagnoal/diagonal minimum over occupied clusters is below
-    `min_stability`, relabel the offending pair as one cluster in the
-    consensus AND the bootstrap assignments (both sides, as the reference
-    does), then recompute."""
+    """Host loop over the tiny stability matrix (reference :489-495).
+
+    The matrix is computed ONCE; the loop then patch-and-rescans it exactly
+    as the reference does — merge the argmin pair's labels in the consensus,
+    set the pair's two entries to 1, scan again — with NO recomputation, so
+    stale entries of already-merged rows keep participating, as in the
+    reference. (The reference also relabels its boot assignment matrix at
+    :488; that has no observable effect — neither the stability matrix nor
+    anything downstream reads boot labels afterwards — so it is skipped.)
+    Diagonal minima (a cluster unstable against itself) merge nothing in the
+    reference either: its clustersToMerge[1]==[2] relabelling is a no-op, and
+    the diag patch to 1 gives progress — replicated here.
+    """
     consensus = np.asarray(consensus, np.int32).copy()
-    boot_labels = np.asarray(boot_labels, np.int32).copy()
+    ids = np.unique(consensus)
+    if len(ids) <= 1:
+        return consensus
+    occupied = np.zeros(max_clusters, bool)
+    occupied[ids] = True
+    sm = np.asarray(stability_matrix(consensus, boot_labels, max_clusters))
+    sm = sm.copy()
+    sm[~occupied, :] = np.inf
+    sm[:, ~occupied] = np.inf
     while True:
-        ids = np.unique(consensus)
-        if len(ids) <= 1:
+        flat = int(np.argmin(sm))
+        a, b = np.divmod(flat, sm.shape[1])
+        if sm[a, b] >= min_stability:
             return consensus
-        occupied = np.zeros(max_clusters, bool)
-        occupied[ids] = True
-        sm = np.asarray(
-            stability_matrix(consensus, boot_labels, max_clusters)
-        )
-        sm_occ = sm[np.ix_(occupied, occupied)]
-        if np.min(sm_occ) >= min_stability:
-            return consensus
-        flat = int(np.argmin(sm_occ))
-        a, b = np.divmod(flat, sm_occ.shape[1])
-        ca, cb = ids[a], ids[b]
-        if ca == cb:
-            # an unstable diagonal: the cluster itself is not reproducible;
-            # merge it into its most-confused partner (row argmin off-diag)
-            row = sm[ca].copy()
-            row[ca] = np.inf
-            row[~occupied] = np.inf
-            cb = int(np.argmin(row))
-        lo, hi = min(ca, cb), max(ca, cb)
-        consensus[consensus == hi] = lo
-        # merging inside boot labels: only cluster ids of the *consensus*
-        # labelling are merged there in the reference; boot labels use their
-        # own id space, so only the consensus side changes here (the Rand
-        # contingency handles the rest)
-        return merge_unstable_clusters(consensus, boot_labels, min_stability, max_clusters)
+        if a != b:
+            # reference :487: cells of the col cluster move to the row cluster
+            consensus[consensus == b] = a
+        sm[a, b] = 1.0
+        sm[b, a] = 1.0

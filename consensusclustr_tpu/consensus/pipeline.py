@@ -7,7 +7,7 @@ Mirrors reference R/consensusClust.R:388-511 (SURVEY §3.1):
   assignment matrix + NA->-1 (:404) -> int32 [B, n] with -1 masks
   C++ Jaccard + parDist (:411-421)  -> one batched einsum/Pallas pass
   consensus clustering (:423-441)   -> knn_from_distance -> SNN -> Leiden grid
-  silhouette ranking on PCA (:445)  -> candidate_score(singleton_floor=True)
+  silhouette ranking on PCA (:445)  -> consensus_candidate_score
   small-cluster merge (:461-467)    -> merge_small_clusters on Jaccard dists
   stability merge (:469-497)        -> merge_unstable_clusters
   no-bootstrap path (:498-511)      -> single grid + Euclidean small-merge
@@ -32,7 +32,7 @@ from consensusclustr_tpu.cluster.engine import align_to_cells, cluster_grid
 from consensusclustr_tpu.cluster.knn import knn_from_distance
 from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
-from consensusclustr_tpu.cluster.engine import candidate_score
+from consensusclustr_tpu.cluster.engine import consensus_candidate_score
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
 from consensusclustr_tpu.consensus.cocluster import coclustering_distance
@@ -129,9 +129,12 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     out_labels, out_scores = [], []
     for s in range(0, cfg.nboots, chunk):
         e = min(s + chunk, cfg.nboots)
+        # min_size=0: the reference never passes its minSize into the boot
+        # grids (:394-395 vs :650's minSize=0 default) — the 0.15 floor is
+        # inert here and only bites in the null sims (minSize=5).
         labels, scores = _boot_batch(
             keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
-            jnp.asarray(float(cfg.min_size), jnp.float32),
+            jnp.float32(0.0),
             len(cfg.res_range), cfg.max_clusters, 20, robust, n,
         )
         out_labels.append(np.asarray(labels))
@@ -155,7 +158,6 @@ def _consensus_grid(
     pca: jax.Array,      # [n, d] for silhouette ranking
     res_list: jax.Array,
     k_list,
-    min_size: jax.Array,
     max_clusters: int,
     n_iters: int = 20,
 ):
@@ -172,10 +174,7 @@ def _consensus_grid(
         def one_res(kk, res):
             raw = leiden_fixed(kk, graph, res, n_iters=n_iters)
             compact, n_c, overflow = compact_labels(raw, max_clusters)
-            score = candidate_score(
-                pca, compact, n_c, overflow, min_size, max_clusters,
-                singleton_floor=True,
-            )
+            score = consensus_candidate_score(pca, compact, n_c, overflow, max_clusters)
             return compact, score
 
         labels_k, scores_k = jax.vmap(one_res)(keys, res_list)
@@ -183,7 +182,10 @@ def _consensus_grid(
         all_scores.append(scores_k)
     labels = jnp.concatenate(all_labels, axis=0)
     scores = jnp.concatenate(all_scores, axis=0)
-    best = _ties_last_argmax(scores)
+    # ties to the FIRST tied candidate: the reference ranks with
+    # ties.method="last" here (:453), under which the max rank lands on the
+    # first occurrence — the opposite of the boot path's "first"/last pairing.
+    best = jnp.argmax(scores)
     return labels[best], scores
 
 
@@ -195,12 +197,12 @@ def consensus_cluster(
     n = pca.shape[0]
     res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
     k_list = tuple(int(k) for k in cfg.k_num)
-    min_size_cluster = jnp.asarray(float(cfg.min_size), jnp.float32)
 
     if cfg.nboots <= 1:
-        # no-bootstrap path (reference :498-511)
+        # no-bootstrap path (reference :498-511); min_size=0 as in the boot
+        # path — the reference's :500 call leaves minSize at its 0 default
         grid = cluster_grid(
-            key, pca, res_list, k_list, min_size_cluster,
+            key, pca, res_list, k_list, jnp.float32(0.0),
             max_clusters=cfg.max_clusters,
         )
         best = int(_ties_last_argmax(grid.scores))
@@ -226,7 +228,7 @@ def consensus_cluster(
         jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters
     )
     cons_labels, cons_scores = _consensus_grid(
-        key, dist, pca, res_list, k_list, min_size_cluster, cfg.max_clusters
+        key, dist, pca, res_list, k_list, cfg.max_clusters
     )
     labels = np.asarray(cons_labels)
     dist_np = np.asarray(dist)

@@ -1,0 +1,6 @@
+from consensusclustr_tpu.hierarchy.dendro import (
+    Dendrogram,
+    cluster_distance_matrix,
+    determine_hierarchy,
+)
+from consensusclustr_tpu.hierarchy.clustree import hierarchy_table

@@ -1,0 +1,43 @@
+"""Clustree-style hierarchy table from lineage labels.
+
+Equivalent of the reference's output-assembly dataframe for clustree
+(reference R/consensusClust.R:590-606): lineage labels like "2_1_3" are split
+on "_", prefix-joined per depth (so depth-2 column holds "2_1"), and cells
+whose lineage ended early are forward-filled with their last label (the
+`coalesce2` helper, :1043-1049). The reference then renders this with
+clustree::clustree(prefix="Cluster"); here the table itself is the product —
+any plotting stack can consume it (SURVEY §2.3 clustree row).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def hierarchy_table(assignments: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Columns Cluster1..ClusterD of prefix-joined, forward-filled lineages.
+
+    assignments: per-cell lineage strings ("2", "2_1", "2_1_3", ...).
+    """
+    parts: List[List[str]] = [str(a).split("_") for a in assignments]
+    depth = max(len(p) for p in parts)
+    table: Dict[str, np.ndarray] = {}
+    for d in range(depth):
+        col = ["_".join(p[: d + 1]) if len(p) > d else "_".join(p) for p in parts]
+        table[f"Cluster{d + 1}"] = np.asarray(col, dtype=object)
+    return table
+
+
+def hierarchy_edges(assignments: Sequence[str]) -> List[tuple]:
+    """(parent, child, n_cells) edges of the lineage tree — the clustree
+    graph structure without the plotting dependency."""
+    table = hierarchy_table(assignments)
+    cols = sorted(table, key=lambda c: int(c.removeprefix("Cluster")))
+    edges: Dict[tuple, int] = {}
+    for a, b in zip(cols[:-1], cols[1:]):
+        for parent, child in zip(table[a], table[b]):
+            if parent != child:
+                edges[(parent, child)] = edges.get((parent, child), 0) + 1
+    return [(p, c, n) for (p, c), n in sorted(edges.items())]

@@ -1,0 +1,144 @@
+"""Cluster hierarchy: centroid-linkage distances + complete-linkage dendrogram.
+
+Equivalent of the reference's ``determineHierachy`` (sic)
+(reference R/consensusClust.R:699-735): the cluster x cluster distance is the
+mean of all cell-cell distances between the two clusters' members, and the
+dendrogram is complete-linkage agglomeration over that matrix. Cluster counts
+are tiny (tens), so this layer is deliberately host-side numpy/scipy
+(SURVEY §2.2 hclust row) — the expensive object, the cell x cell distance
+matrix, was already computed on device.
+
+``Dendrogram`` also carries the cut/walk operations ``testSplits`` needs
+(cophenetic heights, cut-at-height memberships, subtrees;
+reference :894-905, 985, 1003-1034).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+from scipy.cluster import hierarchy as sch
+from scipy.spatial.distance import squareform
+
+
+def cluster_distance_matrix(
+    dist: np.ndarray, assignments: Sequence
+) -> tuple[np.ndarray, List]:
+    """Mean between-member distance per cluster pair (reference :703-721).
+
+    dist: [n, n] cell-cell distances; assignments: length-n labels (any
+    hashable). Returns ([C, C] matrix, cluster label list in first-seen order
+    of the sorted unique labels).
+    """
+    dist = np.asarray(dist)
+    labels = np.asarray(assignments)
+    uniq = _sorted_unique(labels)
+    c = len(uniq)
+    out = np.zeros((c, c), dtype=np.float64)
+    members = [np.flatnonzero(labels == u) for u in uniq]
+    for i in range(c):
+        for j in range(i + 1, c):
+            block = dist[np.ix_(members[i], members[j])]
+            out[i, j] = out[j, i] = float(np.mean(block))
+    return out, list(uniq)
+
+
+def _sorted_unique(labels: np.ndarray) -> list:
+    uniq = list(dict.fromkeys(labels.tolist()))
+    try:
+        return sorted(uniq, key=lambda v: (0, float(v)))
+    except (TypeError, ValueError):
+        return sorted(uniq, key=str)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dendrogram:
+    """Complete-linkage tree over cluster labels.
+
+    linkage: scipy-format [(C-1), 4] merge matrix; labels[i] is leaf i.
+    """
+
+    linkage: np.ndarray
+    labels: List
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.labels)
+
+    def cophenetic_heights(self) -> np.ndarray:
+        """Sorted unique merge heights (the reference's `sps`, :895)."""
+        return np.unique(self.linkage[:, 2])
+
+    def first_split_height(self) -> float:
+        """The reference's cut height for the top split (:895-897):
+        ``sps = sort(unique(cophenetic), decreasing=T);
+        floor(sps[max(which(sps > 0.85 * max(sps)))])`` — i.e. the floor of
+        the SMALLEST merge height above 0.85 * max, so closely-spaced top
+        merges are all cut in one step. The reference floors unconditionally,
+        which on small-height trees (e.g. Jaccard distances <= 1) cuts at 0
+        and shatters the tree; guard by backing off to just below the selected
+        height (intent per SURVEY §7.3 item 6 / quirks ledger)."""
+        sps = self.cophenetic_heights()
+        top = float(sps.max())
+        sel = float(sps[sps > 0.85 * top].min())
+        h = float(np.floor(sel))
+        if not (sps.min() <= h < top):
+            h = float(np.nextafter(sel, -np.inf))
+        return h
+
+    def cut_memberships(self, height: float) -> np.ndarray:
+        """Branch id per leaf when cutting at `height` (dendextend::cutree
+        analog, :897). Ids are 1..n_branches in leaf order."""
+        if self.n_leaves == 1:
+            return np.array([1])
+        flat = sch.fcluster(self.linkage, t=height, criterion="distance")
+        return flat
+
+    def subtrees(self, height: float) -> List["Dendrogram"]:
+        """The lower subtrees after cutting at `height` (stats::cut()$lower
+        analog, :1003). Singleton branches come back as one-leaf trees."""
+        memb = self.cut_memberships(height)
+        out = []
+        for b in np.unique(memb):
+            leaf_idx = np.flatnonzero(memb == b)
+            out.append(self.restrict([self.labels[i] for i in leaf_idx]))
+        return out
+
+    def restrict(self, keep_labels: Sequence) -> "Dendrogram":
+        """Subtree over a label subset, re-agglomerated from cophenetic
+        distances (equivalent for complete linkage)."""
+        keep = [l for l in self.labels if l in set(keep_labels)]
+        if len(keep) <= 1:
+            return Dendrogram(linkage=np.zeros((0, 4)), labels=keep)
+        full = squareform(sch.cophenet(self.linkage))
+        idx = [self.labels.index(l) for l in keep]
+        sub = full[np.ix_(idx, idx)]
+        z = sch.linkage(squareform(sub, checks=False), method="complete")
+        return Dendrogram(linkage=z, labels=keep)
+
+    def merge_heights_below(self, height: float) -> np.ndarray:
+        return self.linkage[self.linkage[:, 2] <= height, 2]
+
+
+def determine_hierarchy(
+    distance_matrix: np.ndarray,
+    assignments: Sequence,
+    return_: str = "dendrogram",
+) -> Union[Dendrogram, np.ndarray]:
+    """Public API mirroring the reference export (NAMESPACE:4; :699-735).
+
+    distance_matrix: [n, n] cell-cell distances (co-clustering or Euclidean
+    PCA). return_: "dendrogram" | "hclust" (same object here) | "distance"
+    (the [C, C] mean-linkage matrix).
+    """
+    if return_ not in ("dendrogram", "hclust", "distance"):
+        raise ValueError(f"return_ must be dendrogram|hclust|distance; got {return_!r}")
+    cmat, labels = cluster_distance_matrix(distance_matrix, assignments)
+    if return_ == "distance":
+        return cmat
+    if len(labels) <= 1:
+        return Dendrogram(linkage=np.zeros((0, 4)), labels=labels)
+    z = sch.linkage(squareform(cmat, checks=False), method="complete")
+    return Dendrogram(linkage=z, labels=labels)

@@ -1,0 +1,8 @@
+from consensusclustr_tpu.nulltest.nb import fit_nb, nb_cdf, nb_quantile
+from consensusclustr_tpu.nulltest.copula import (
+    CopulaModel,
+    fit_nb_copula,
+    simulate_counts,
+)
+from consensusclustr_tpu.nulltest.null import generate_null_statistics
+from consensusclustr_tpu.nulltest.splits import test_splits, null_p_value

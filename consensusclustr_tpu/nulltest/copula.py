@@ -1,0 +1,95 @@
+"""Gaussian copula over NB marginals: fit + simulation.
+
+TPU-native equivalent of scDesign3's ``fit_copula(gaussian)`` /
+``extract_para`` / ``simu_new`` slice used by the reference's null model
+(reference R/consensusClust.R:916-921, 763-778): the gene-gene dependence of
+the real counts is captured as a Gaussian copula correlation matrix, and null
+datasets are drawn by sampling correlated normals and pushing them through
+the per-gene NB quantile function.
+
+Everything is one fixed-shape device program: the distributional transform is
+elementwise, the correlation matrix is one [G, G] matmul, sampling is a
+Cholesky matmul + quantile bisection — all vmappable over the >= 20 null
+replicates (SURVEY §2.2 scDesign3 row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+from jax.scipy.stats import norm as jnorm
+
+from consensusclustr_tpu.nulltest.nb import fit_nb, nb_cdf, nb_quantile
+
+_U_EPS = 1e-6
+
+
+class CopulaModel(NamedTuple):
+    """NB marginals + Gaussian copula factor (the `extract_para` analog)."""
+
+    mu: jax.Array     # [G] NB means
+    theta: jax.Array  # [G] NB dispersions
+    chol: jax.Array   # [G, G] lower Cholesky factor of the copula correlation
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _copula_corr(key: jax.Array, counts: jax.Array, mu: jax.Array, theta: jax.Array,
+                 shrink: jax.Array) -> jax.Array:
+    """Copula correlation via the randomized distributional transform.
+
+    For discrete marginals the probability integral transform is randomized:
+    u = F(x-1) + V * (F(x) - F(x-1)), V ~ U(0,1) — without this the normal
+    scores of ties collapse and correlations are biased (scDesign3 does the
+    same). Shrinkage toward I keeps the matrix SPD in float32.
+    """
+    x = jnp.asarray(counts, jnp.float32)
+    n = x.shape[0]
+    hi = nb_cdf(x, mu[None, :], theta[None, :])
+    lo = nb_cdf(x - 1.0, mu[None, :], theta[None, :])
+    v = jax.random.uniform(key, x.shape)
+    u = jnp.clip(lo + v * (hi - lo), _U_EPS, 1.0 - _U_EPS)
+    z = ndtri(u)
+    z = (z - jnp.mean(z, axis=0)) / jnp.maximum(jnp.std(z, axis=0), 1e-6)
+    corr = (z.T @ z) / n
+    g = corr.shape[0]
+    eye = jnp.eye(g, dtype=corr.dtype)
+    corr = (1.0 - shrink) * corr + shrink * eye
+    return 0.5 * (corr + corr.T)
+
+
+def fit_nb_copula(
+    key: jax.Array,
+    counts: jax.Array,
+    shrink: float = 0.05,
+    n_iters: int = 30,
+) -> CopulaModel:
+    """Fit the full null generative model to real counts [n_cells, n_genes].
+
+    Mirrors the reference's construct_data -> fit_marginal -> fit_copula ->
+    extract_para chain (R/consensusClust.R:909-921) as two device passes:
+    vmapped NB MLE, then one correlation matmul + Cholesky.
+    """
+    counts = jnp.asarray(counts, jnp.float32)
+    mu, theta = fit_nb(counts, n_iters=n_iters)
+    corr = _copula_corr(key, counts, mu, theta, jnp.float32(shrink))
+    chol = jnp.linalg.cholesky(corr)
+    # float32 SPD safety net: if Cholesky failed, retreat to independence.
+    ok = jnp.all(jnp.isfinite(chol))
+    chol = jnp.where(ok, chol, jnp.eye(corr.shape[0], dtype=corr.dtype))
+    return CopulaModel(mu=mu, theta=theta, chol=chol)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cells",))
+def simulate_counts(key: jax.Array, model: CopulaModel, n_cells: int) -> jax.Array:
+    """Draw one null count matrix [n_cells, G] (the `simu_new` analog,
+    reference R/consensusClust.R:763-778): correlated normals -> uniforms ->
+    NB quantiles."""
+    g = model.mu.shape[0]
+    eps = jax.random.normal(key, (n_cells, g))
+    z = eps @ model.chol.T
+    u = jnp.clip(jnorm.cdf(z), _U_EPS, 1.0 - _U_EPS)
+    return nb_quantile(u, model.mu[None, :], model.theta[None, :])

@@ -1,0 +1,128 @@
+"""Batched null-statistic generation.
+
+Equivalent of the reference's ``generateNullStatistic``
+(reference R/consensusClust.R:759-814): simulate a null count matrix from the
+fitted NB-copula model, normalise it with deconvolution size factors, optionally
+regress covariates, PCA to the real data's pc_num, cluster over the hardcoded
+null resolution sweep (min_size=5, :803-804), and return the mean
+approx-silhouette of the chosen assignment (0 for a single cluster or a failed
+PCA, :806-813).
+
+Where the reference runs 20-60 of these pipelines as separate R worker
+processes (bplapply at :933-963), here the whole simulate -> normalise -> PCA
+-> cluster -> silhouette chain is ONE jitted program vmapped over a chunk of
+replicates (SURVEY §2.4 null-simulation row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.config import NULL_SIM_MIN_SIZE, NULL_SIM_RES_RANGE
+from consensusclustr_tpu.cluster.engine import cluster_grid
+from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+from consensusclustr_tpu.linalg.pca import truncated_pca
+from consensusclustr_tpu.nulltest.copula import CopulaModel, simulate_counts
+from consensusclustr_tpu.prep.regress import lm_residuals
+from consensusclustr_tpu.prep.sizefactors import (
+    deconvolution_factors_jit,
+    default_pool_sizes,
+    stabilize_size_factors,
+)
+from consensusclustr_tpu.prep.transform import shifted_log
+from consensusclustr_tpu.utils.rng import sim_key
+
+
+def _ties_last_argmax(scores: jax.Array) -> jax.Array:
+    r = scores.shape[0]
+    return (r - 1 - jnp.argmax(scores[::-1])).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_cells", "pc_num", "k_list", "pool_sizes", "max_clusters", "has_cov"),
+)
+def _null_stat_batch(
+    keys: jax.Array,                 # [chunk, 2] split per sim
+    model: CopulaModel,
+    covariates: jax.Array,           # [n_cells, n_cov] or dummy [n_cells, 1]
+    res_list: jax.Array,             # [R]
+    n_cells: int,
+    pc_num: int,
+    k_list: Tuple[int, ...],
+    pool_sizes: Tuple[int, ...],
+    max_clusters: int,
+    has_cov: bool,
+) -> jax.Array:
+    def one(key):
+        k_sim, k_pca, k_clu = jax.random.split(key, 3)
+        counts = simulate_counts(k_sim, model, n_cells)
+        sf = stabilize_size_factors(deconvolution_factors_jit(counts, pool_sizes))
+        y = shifted_log(counts, sf)
+        if has_cov:
+            y = lm_residuals(y, covariates)
+        res = truncated_pca(y, pc_num, center=True, scale=True, key=k_pca)
+        pca = res.scores
+        # PCA failure -> 0 statistic (reference :788-798): scrub non-finite
+        # scores so the clustering path stays NaN-free, flag for the fallback.
+        pca_ok = jnp.all(jnp.isfinite(pca))
+        pca = jnp.where(jnp.isfinite(pca), pca, 0.0)
+        grid = cluster_grid(
+            k_clu, pca, res_list, k_list,
+            jnp.float32(NULL_SIM_MIN_SIZE), max_clusters=max_clusters,
+        )
+        best = _ties_last_argmax(grid.scores)
+        labels = grid.labels[best]
+        n_c = grid.n_clusters[best]
+        sil = mean_silhouette_score(pca, labels, max_clusters)
+        stat = jnp.where((n_c <= 1) | ~pca_ok, 0.0, sil)
+        return jnp.where(jnp.isfinite(stat), stat, 0.0)
+
+    return jax.vmap(one)(keys)
+
+
+def generate_null_statistics(
+    key: jax.Array,
+    model: CopulaModel,
+    n_cells: int,
+    pc_num: int,
+    n_sims: int = 20,
+    k_num=(10, 15, 20),
+    covariates: Optional[np.ndarray] = None,
+    max_clusters: int = 64,
+    round_id: int = 0,
+    chunk: int = 4,
+) -> np.ndarray:
+    """n_sims null silhouettes, chunk-vmapped on device.
+
+    `round_id` keys the adaptive rounds (the reference bumps RNGseed+1 for the
+    extra 20-sim rounds, :944/:956 — here it folds into the PRNG tree).
+    """
+    res_list = jnp.asarray(NULL_SIM_RES_RANGE, jnp.float32)
+    k_list = tuple(int(k) for k in k_num)
+    pool_sizes = default_pool_sizes(n_cells)
+    has_cov = covariates is not None
+    cov = (
+        jnp.asarray(covariates, jnp.float32)
+        if has_cov
+        else jnp.zeros((n_cells, 1), jnp.float32)
+    )
+    keys = jax.vmap(lambda s: sim_key(key, s, round_id))(jnp.arange(n_sims))
+    out = []
+    for s in range(0, n_sims, chunk):
+        e = min(s + chunk, n_sims)
+        out.append(
+            np.asarray(
+                _null_stat_batch(
+                    keys[s:e], model, cov, res_list,
+                    int(n_cells), int(pc_num), k_list, pool_sizes,
+                    int(max_clusters), has_cov,
+                )
+            )
+        )
+    return np.concatenate(out)

@@ -1,0 +1,280 @@
+"""Statistical testing of clusterings against the NB-copula null.
+
+Equivalent of the reference's ``testSplits``
+(reference R/consensusClust.R:891-1037): fit the null generative model to the
+(HVG) counts, simulate >= 20 null datasets, cluster each, fit a normal to the
+null silhouettes and compute p = 1 - Phi(silhouette_real); clusterings (or
+individual dendrogram splits) whose silhouette is not significantly better
+than the null are rejected.
+
+Division of labor (SURVEY §7.1): all statistics run on device in batched form
+(`fit_nb_copula`, `generate_null_statistics`); this module is the irregular
+host control — the adaptive 20/20/20 simulation rounds (:933-964) and the
+`test_splits_seperately` dendrogram walk (:894-905, 966-1036).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+from consensusclustr_tpu.hierarchy.dendro import Dendrogram
+from consensusclustr_tpu.nulltest.copula import fit_nb_copula
+from consensusclustr_tpu.nulltest.null import generate_null_statistics
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import cluster_key, root_key
+
+
+def null_p_value(silhouette: float, null_stats: np.ndarray) -> float:
+    """Normal-MLE fit to the null silhouettes + upper-tail p-value
+    (reference :939-940: MASS::fitdistr 'normal', p = 1 - pnorm)."""
+    m = float(np.mean(null_stats))
+    sd = float(np.std(null_stats))  # MLE (ddof=0), matching fitdistr
+    if sd < 1e-12:
+        return 0.0 if silhouette > m else 1.0
+    z = (silhouette - m) / sd
+    return 0.5 * math.erfc(z / math.sqrt(2.0))
+
+
+def _codes(labels: np.ndarray) -> np.ndarray:
+    uniq, codes = np.unique(np.asarray(labels, dtype=str), return_inverse=True)
+    return codes.astype(np.int32)
+
+
+def _silhouette(pca: np.ndarray, labels: np.ndarray, max_clusters: int) -> float:
+    codes = _codes(labels)
+    mc = max(int(max_clusters), int(codes.max()) + 1)
+    return float(
+        mean_silhouette_score(jnp.asarray(pca, jnp.float32), jnp.asarray(codes), mc)
+    )
+
+
+def _clustering_rejected(
+    key: jax.Array,
+    counts: np.ndarray,
+    silhouette: float,
+    pc_num: int,
+    *,
+    alpha: float,
+    k_num,
+    covariates,
+    n_sims: int,
+    max_clusters: int,
+    log: Optional[LevelLog],
+) -> tuple:
+    """One full adaptive null test.
+
+    Returns (rejected, null_stats): rejected == True means the clustering is
+    not significant; null_stats is returned so callers can re-test merged
+    variants against the SAME null fit, as the reference's failed-split loop
+    does (:998 computes new p-values from the existing `fit`)."""
+    n_cells = counts.shape[0]
+    model = fit_nb_copula(cluster_key(key, "copula_fit"), jnp.asarray(counts, jnp.float32))
+
+    stats = generate_null_statistics(
+        key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+        covariates=covariates, max_clusters=max_clusters, round_id=0,
+    )
+    p = null_p_value(silhouette, stats)
+    # Adaptive refinement near the boundary (reference :943-964): +20 sims if
+    # p in [0.05, 0.1), then +20 more if still in [0.05, 0.075).
+    if 0.05 <= p < 0.1:
+        stats = np.concatenate([
+            stats,
+            generate_null_statistics(
+                key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+                covariates=covariates, max_clusters=max_clusters, round_id=1,
+            ),
+        ])
+        p = null_p_value(silhouette, stats)
+    if 0.05 <= p < 0.075:
+        stats = np.concatenate([
+            stats,
+            generate_null_statistics(
+                key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+                covariates=covariates, max_clusters=max_clusters, round_id=2,
+            ),
+        ])
+        p = null_p_value(silhouette, stats)
+    if log:
+        log.event(
+            "null_test", silhouette=silhouette, p_value=p,
+            null_mean=float(np.mean(stats)), null_sd=float(np.std(stats)),
+            n_sims=len(stats),
+        )
+    return p >= alpha, stats
+
+
+def test_splits(
+    counts: np.ndarray,
+    pca: np.ndarray,
+    dend: Optional[Dendrogram],
+    assignments: Sequence,
+    *,
+    pc_num: Optional[int] = None,
+    k_num=(10, 15, 20),
+    alpha: float = 0.05,
+    silhouette_thresh: float = 0.45,
+    covariates: Optional[np.ndarray] = None,
+    n_sims: int = 20,
+    seed: int = 123,
+    key: Optional[jax.Array] = None,
+    test_separately: bool = False,
+    max_clusters: int = 64,
+    log: Optional[LevelLog] = None,
+) -> np.ndarray:
+    """Public API mirroring the reference export (NAMESPACE:6; :891).
+
+    counts: [n_cells, n_hvg] raw counts (the reference builds an SCE of HVG
+    counts, :526-531). pca: [n_cells, d]. assignments: per-cell labels.
+    Returns the surviving assignments — unchanged, fully merged to "1"
+    (test_separately=False, :967-970), or with individual failed splits
+    collapsed (test_separately=True).
+    """
+    assignments = np.asarray(assignments, dtype=object)
+    n = len(assignments)
+    if key is None:
+        key = root_key(seed)
+    counts = np.asarray(counts, dtype=np.float32)
+    pca = np.asarray(pca, dtype=np.float32)
+    if pc_num is None:
+        pc_num = pca.shape[1]
+
+    if len(set(assignments.tolist())) <= 1:
+        return assignments
+
+    if not test_separately or dend is None or dend.n_leaves <= 1:
+        sil = _silhouette(pca, assignments, max_clusters)
+        if sil > silhouette_thresh:
+            # reference :907 — confident clusterings skip the null test
+            return assignments
+        rejected, _ = _clustering_rejected(
+            key, counts, sil, pc_num,
+            alpha=alpha, k_num=k_num, covariates=covariates,
+            n_sims=n_sims, max_clusters=max_clusters, log=log,
+        )
+        if rejected:
+            return np.full(n, "1", dtype=object)
+        return assignments
+
+    return _test_tree(
+        key, counts, pca, dend, assignments,
+        pc_num=pc_num, k_num=k_num, alpha=alpha,
+        silhouette_thresh=silhouette_thresh, covariates=covariates,
+        n_sims=n_sims, max_clusters=max_clusters, log=log, depth=0,
+    )
+
+
+def _branch_structures(pca, dend, labels, max_clusters):
+    """Cut the tree at its first split and derive (h, memberships-per-leaf,
+    per-cell branch codes, branch-level silhouette) — the reference's
+    :894-905 preamble, also recomputed after each merge step (:984-998)."""
+    h = dend.first_split_height()
+    memb = dend.cut_memberships(h)
+    branch_of = {leaf: int(b) for leaf, b in zip(dend.labels, memb)}
+    branch_codes = np.asarray([branch_of.get(l, 1) for l in labels])
+    sil = (
+        _silhouette(pca, branch_codes, max_clusters)
+        if len(np.unique(branch_codes)) > 1
+        else 1.0
+    )
+    return h, branch_of, branch_codes, sil
+
+
+def _euclidean(pca: np.ndarray) -> np.ndarray:
+    sq = np.sum(pca * pca, axis=1)
+    d2 = sq[:, None] - 2.0 * (pca @ pca.T) + sq[None, :]
+    return np.sqrt(np.maximum(d2, 0.0))
+
+
+def _test_tree(
+    key: jax.Array,
+    counts: np.ndarray,
+    pca: np.ndarray,
+    dend: Dendrogram,
+    assignments: np.ndarray,
+    *,
+    pc_num: int,
+    k_num,
+    alpha: float,
+    silhouette_thresh: float,
+    covariates,
+    n_sims: int,
+    max_clusters: int,
+    log: Optional[LevelLog],
+    depth: int,
+) -> np.ndarray:
+    """Per-split walk (reference :894-905, 966-1036): test this subtree's top
+    split; on failure, softly merge the majority cluster of each branch and
+    re-test the rebuilt tree against the SAME null fit until a split survives
+    or one cluster remains (:971-1001); then recurse into the surviving
+    branches with subset counts/pca (:1003-1034)."""
+    labels = assignments.copy()
+    if dend.n_leaves <= 1 or len(set(labels.tolist())) <= 1:
+        return labels
+
+    h, branch_of, branch_codes, sil = _branch_structures(
+        pca, dend, labels, max_clusters
+    )
+    if len(np.unique(branch_codes)) <= 1:
+        return labels
+
+    if sil <= silhouette_thresh:
+        rejected, null_stats = _clustering_rejected(
+            cluster_key(key, f"split_{depth}"), counts, sil, pc_num,
+            alpha=alpha, k_num=k_num, covariates=covariates,
+            n_sims=n_sims, max_clusters=max_clusters, log=log,
+        )
+        # Failed split: merge the majority cluster of each branch into one
+        # cluster, rebuild the dendrogram from Euclidean PCA distances, and
+        # re-test the new top split against the existing null fit — the
+        # reference's while loop at :971-1001.
+        while rejected and len(set(labels.tolist())) > 1:
+            reps = []
+            for b in sorted(set(branch_of.values())):
+                in_branch = [l for l in set(labels.tolist()) if branch_of.get(l) == b]
+                if not in_branch:
+                    continue
+                sizes = {l: int(np.sum(labels == l)) for l in in_branch}
+                reps.append(max(sizes, key=sizes.get))
+            if len(reps) < 2:
+                break
+            labels[np.isin(labels, np.asarray(reps, dtype=object))] = reps[0]
+            if len(set(labels.tolist())) <= 1:
+                break
+            dend = determine_hierarchy(_euclidean(pca), labels)
+            if dend.n_leaves <= 1:
+                break
+            h, branch_of, branch_codes, sil = _branch_structures(
+                pca, dend, labels, max_clusters
+            )
+            p = null_p_value(sil, null_stats)
+            if log:
+                log.event("split_retest", silhouette=sil, p_value=p, depth=depth)
+            rejected = p >= alpha
+        if len(set(labels.tolist())) <= 1:
+            return labels
+
+    # surviving split: test each branch's own sub-splits on its cells
+    # (reference :1003-1034 — only subtrees whose leaves still exist recurse)
+    for sub in dend.subtrees(h):
+        live = [l for l in sub.labels if l in set(labels.tolist())]
+        if sub.n_leaves <= 1 or len(live) <= 1:
+            continue
+        mask = np.isin(labels, np.asarray(live, dtype=object))
+        if mask.sum() < 2:
+            continue
+        cov_sub = covariates[mask] if covariates is not None else None
+        labels[mask] = _test_tree(
+            cluster_key(key, f"sub_{depth}_{sub.labels[0]}"),
+            counts[mask], pca[mask], sub.restrict(live), labels[mask],
+            pc_num=pc_num, k_num=k_num, alpha=alpha,
+            silhouette_thresh=silhouette_thresh, covariates=cov_sub,
+            n_sims=n_sims, max_clusters=max_clusters, log=log, depth=depth + 1,
+        )
+    return labels

@@ -119,10 +119,7 @@ def deconvolution_factors(
     lib = jnp.maximum(lib, 1e-12)
 
     if pool_sizes is None:
-        max_size = max(3, n // 2)
-        pool_sizes = tuple(s for s in _DEFAULT_POOL_SIZES if s <= max_size)
-        if not pool_sizes:
-            pool_sizes = tuple(sorted({3, min(5, max_size), max_size}))
+        pool_sizes = default_pool_sizes(n)
     sizes = tuple(int(s) for s in pool_sizes)
 
     # Filter to reasonably-expressed genes for the median ratios (scran's
@@ -152,6 +149,52 @@ def deconvolution_factors(
     inv[ring] = np.arange(n)
     sf = sf_ring[jnp.asarray(inv)]
     return sf / jnp.maximum(jnp.mean(sf), 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("sizes", "n_ratio_genes"))
+def deconvolution_factors_jit(
+    counts: jax.Array,
+    sizes: tuple,
+    n_ratio_genes: int = 512,
+) -> jax.Array:
+    """Fully-traceable deconvolution size factors (unit mean).
+
+    Same estimator as `deconvolution_factors` but with every step expressed in
+    jnp so the whole pass can sit inside a jitted / vmapped program — used by
+    the null-simulation pipeline, where the reference re-runs
+    shifted_log_transform(size_factors="deconvolution") inside every simulated
+    replicate (reference R/consensusClust.R:779). Gene selection for the pool
+    ratios is a fixed-width top-k by mean count instead of the host-side
+    min-mean filter.
+    """
+    counts = jnp.asarray(counts, jnp.float32)
+    n = counts.shape[0]
+    lib = jnp.maximum(jnp.sum(counts, axis=1), 1e-12)
+
+    order = jnp.argsort(lib)
+    half = (n + 1) // 2
+    ring = (
+        jnp.zeros((n,), jnp.int32)
+        .at[0::2].set(order[:half].astype(jnp.int32))
+        .at[1::2].set(order[half:][::-1].astype(jnp.int32))
+    )
+
+    g = min(int(n_ratio_genes), counts.shape[1])
+    _, keep = jax.lax.top_k(jnp.mean(counts, axis=0), g)
+    scaled = counts[ring][:, keep] / lib[ring, None]
+    theta = jnp.maximum(_deconv_theta(scaled, sizes), 1e-8)
+
+    sf = jnp.zeros((n,), jnp.float32).at[ring].set(theta * lib[ring])
+    return sf / jnp.maximum(jnp.mean(sf), 1e-12)
+
+
+def default_pool_sizes(n: int) -> tuple:
+    """Host-side choice of pool window sizes for n cells (static under jit)."""
+    max_size = max(3, n // 2)
+    sizes = tuple(s for s in _DEFAULT_POOL_SIZES if s <= max_size)
+    if not sizes:
+        sizes = tuple(sorted({3, min(5, max_size), max_size}))
+    return sizes
 
 
 def stabilize_size_factors(sf: jax.Array) -> jax.Array:

@@ -12,7 +12,17 @@ simulation at the same index.
 
 from __future__ import annotations
 
+import zlib
+
 import jax
+
+
+def _tag(t):
+    """Stable integer for fold_in: ints pass through, strings CRC32-hash
+    (Python's hash() is salted per process and would break determinism)."""
+    if isinstance(t, str):
+        return zlib.crc32(t.encode()) & 0x7FFFFFFF
+    return t
 
 _BOOT_SPACE = 0x0B007
 _SIM_SPACE = 0x51111
@@ -38,7 +48,7 @@ def sim_key(key: jax.Array, sim_id, round_id: int = 0) -> jax.Array:
 
 def cluster_key(key: jax.Array, tag) -> jax.Array:
     """Stream for tie-breaking inside the clustering kernel."""
-    return jax.random.fold_in(jax.random.fold_in(key, _CLUSTER_SPACE), tag)
+    return jax.random.fold_in(jax.random.fold_in(key, _CLUSTER_SPACE), _tag(tag))
 
 
 def depth_key(key: jax.Array, depth: int, child_id: int) -> jax.Array:

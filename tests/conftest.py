@@ -23,6 +23,10 @@ import pytest  # noqa: E402
 assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running statistical test")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,88 @@
+"""hierarchy/: determineHierachy parity + dendrogram cut/walk + clustree table."""
+
+import numpy as np
+
+from consensusclustr_tpu.hierarchy import (
+    cluster_distance_matrix,
+    determine_hierarchy,
+    hierarchy_table,
+)
+from consensusclustr_tpu.hierarchy.clustree import hierarchy_edges
+
+
+def _three_group_dist():
+    # 1-D points: groups at 0, 1, 10 -> groups {a,b} merge before c
+    x = np.array([0.0, 0.1, 1.0, 1.1, 10.0, 10.1])[:, None]
+    d = np.abs(x - x.T)
+    labels = np.array(["a", "a", "b", "b", "c", "c"])
+    return d, labels
+
+
+def test_cluster_distance_matrix_is_mean_linkage():
+    d, labels = _three_group_dist()
+    cmat, uniq = cluster_distance_matrix(d, labels)
+    assert uniq == ["a", "b", "c"]
+    # mean distance a<->b: |{0,.1} x {1,1.1}| = mean(1, 1.1, .9, 1) = 1.0
+    np.testing.assert_allclose(cmat[0, 1], 1.0, atol=1e-6)
+    assert cmat[0, 2] > 5.0
+    np.testing.assert_allclose(cmat, cmat.T)
+    assert np.all(np.diag(cmat) == 0)
+
+
+def test_determine_hierarchy_topology():
+    d, labels = _three_group_dist()
+    dend = determine_hierarchy(d, labels)
+    assert sorted(dend.labels) == ["a", "b", "c"]
+    # first merge joins a and b (height 1), c joins last (height ~9.45)
+    heights = dend.cophenetic_heights()
+    assert heights[0] < 2.0 and heights[-1] > 5.0
+
+    memb = dend.cut_memberships(dend.first_split_height())
+    by_branch = {}
+    for leaf, b in zip(dend.labels, memb):
+        by_branch.setdefault(b, set()).add(leaf)
+    assert {frozenset(s) for s in by_branch.values()} == {
+        frozenset({"a", "b"}),
+        frozenset({"c"}),
+    }
+
+
+def test_determine_hierarchy_distance_return():
+    d, labels = _three_group_dist()
+    cmat = determine_hierarchy(d, labels, return_="distance")
+    assert cmat.shape == (3, 3)
+
+
+def test_subtrees_partition_leaves():
+    d, labels = _three_group_dist()
+    dend = determine_hierarchy(d, labels)
+    subs = dend.subtrees(dend.first_split_height())
+    all_leaves = sorted(l for s in subs for l in s.labels)
+    assert all_leaves == ["a", "b", "c"]
+    sizes = sorted(s.n_leaves for s in subs)
+    assert sizes == [1, 2]
+
+
+def test_single_cluster_dendrogram():
+    d = np.zeros((4, 4))
+    dend = determine_hierarchy(d, ["1"] * 4)
+    assert dend.n_leaves == 1
+    assert dend.cut_memberships(0.5).tolist() == [1]
+
+
+def test_hierarchy_table_prefix_join_and_fill():
+    asgn = ["2", "2_1", "2_1_3", "5"]
+    t = hierarchy_table(asgn)
+    assert list(t) == ["Cluster1", "Cluster2", "Cluster3"]
+    assert t["Cluster1"].tolist() == ["2", "2", "2", "5"]
+    # early-terminating lineages forward-fill (coalesce2 semantics, :1043-1049)
+    assert t["Cluster2"].tolist() == ["2", "2_1", "2_1", "5"]
+    assert t["Cluster3"].tolist() == ["2", "2_1", "2_1_3", "5"]
+
+
+def test_hierarchy_edges():
+    asgn = ["2", "2_1", "2_1_3", "2_2", "5"]
+    edges = hierarchy_edges(asgn)
+    assert ("2", "2_1", 2) in edges
+    assert ("2_1", "2_1_3", 1) in edges
+    assert ("2", "2_2", 1) in edges

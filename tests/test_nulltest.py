@@ -1,0 +1,164 @@
+"""nulltest/: NB MLE, quantile, copula, null pipeline, test_splits.
+
+Mirrors SURVEY §4's required pyramid items 1 (kernels vs known answers) and 3
+(null calibration / power), which the reference only gestures at via its
+rpois @examples (reference R/consensusClust.R:80-120).
+"""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import jax
+import jax.numpy as jnp
+
+from consensusclustr_tpu.nulltest import (
+    fit_nb,
+    fit_nb_copula,
+    generate_null_statistics,
+    nb_cdf,
+    nb_quantile,
+    null_p_value,
+    simulate_counts,
+)
+from consensusclustr_tpu.nulltest import test_splits as run_test_splits
+from consensusclustr_tpu.hierarchy import determine_hierarchy
+
+
+MU, THETA = 5.0, 2.0
+P = THETA / (THETA + MU)
+
+
+def test_fit_nb_recovers_parameters():
+    r = np.random.default_rng(0)
+    x = r.negative_binomial(THETA, P, size=(3000, 6)).astype(np.float32)
+    mu, theta = fit_nb(x)
+    np.testing.assert_allclose(np.asarray(mu), MU, rtol=0.1)
+    np.testing.assert_allclose(np.asarray(theta), THETA, rtol=0.25)
+
+
+def test_fit_nb_poisson_limit():
+    # Poisson data has (almost) no overdispersion: theta must end up in the
+    # near-Poisson regime (variance inflation 1 + mu/theta < 10%), with exact
+    # underdispersion hitting the cap rather than diverging.
+    r = np.random.default_rng(1)
+    x = r.poisson(4.0, size=(800, 5)).astype(np.float32)
+    mu, theta = fit_nb(x)
+    assert np.all(np.asarray(theta) >= 50.0)
+    np.testing.assert_allclose(np.asarray(mu), 4.0, rtol=0.15)
+
+
+def test_nb_cdf_and_quantile_match_scipy():
+    k = np.arange(0, 30, dtype=np.float32)
+    ours = np.asarray(nb_cdf(jnp.asarray(k), jnp.float32(MU), jnp.float32(THETA)))
+    ref = st.nbinom.cdf(k, THETA, P)
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    u = np.array([0.001, 0.05, 0.3, 0.5, 0.77, 0.9, 0.999], dtype=np.float32)
+    q_ours = np.asarray(nb_quantile(jnp.asarray(u), jnp.float32(MU), jnp.float32(THETA)))
+    q_ref = st.nbinom.ppf(u, THETA, P)
+    np.testing.assert_array_equal(q_ours, q_ref)
+
+
+def test_copula_roundtrip_recovers_correlation():
+    """Generate from a known NB copula, fit, regenerate: the planted
+    correlation and NB marginals must survive the round trip."""
+    from consensusclustr_tpu.nulltest.copula import CopulaModel
+
+    g = 5
+    rho = 0.7
+    corr = np.eye(g, dtype=np.float32)
+    corr[0, 1] = corr[1, 0] = rho
+    truth = CopulaModel(
+        mu=jnp.full((g,), 5.0, jnp.float32),
+        theta=jnp.full((g,), 2.0, jnp.float32),
+        chol=jnp.asarray(np.linalg.cholesky(corr)),
+    )
+    x = np.asarray(simulate_counts(jax.random.key(0), truth, 2000))
+    c_planted = np.corrcoef(x[:, 0], x[:, 1])[0, 1]
+    assert c_planted > 0.45  # planted dependence shows in count space
+
+    model = fit_nb_copula(jax.random.key(1), x)
+    sim = np.asarray(simulate_counts(jax.random.key(2), model, 2000))
+    # marginal means and the planted count-space correlation survive
+    np.testing.assert_allclose(sim.mean(0), x.mean(0), rtol=0.2)
+    c_sim = np.corrcoef(sim[:, 0], sim[:, 1])[0, 1]
+    assert abs(c_sim - c_planted) < 0.12
+    # independent pair stays near zero
+    assert abs(np.corrcoef(sim[:, 2], sim[:, 3])[0, 1]) < 0.1
+
+
+def test_null_p_value():
+    stats = np.array([0.1, 0.2, 0.3, 0.2, 0.2])
+    p_mid = null_p_value(0.2, stats)
+    assert 0.4 < p_mid < 0.6
+    assert null_p_value(0.9, stats) < 0.01
+    # degenerate sd
+    assert null_p_value(0.5, np.full(5, 0.2)) == 0.0
+    assert null_p_value(0.1, np.full(5, 0.2)) == 1.0
+
+
+def test_generate_null_statistics_shape_and_range():
+    r = np.random.default_rng(3)
+    counts = r.poisson(3.0, size=(100, 40)).astype(np.float32)
+    key = jax.random.key(0)
+    model = fit_nb_copula(key, counts)
+    stats = generate_null_statistics(
+        key, model, 100, 5, n_sims=4, k_num=(10,), max_clusters=32
+    )
+    assert stats.shape == (4,)
+    assert np.all(np.isfinite(stats))
+    assert np.all(stats >= 0.0) and np.all(stats <= 1.0)
+    # determinism: same key, same stats
+    stats2 = generate_null_statistics(
+        key, model, 100, 5, n_sims=4, k_num=(10,), max_clusters=32
+    )
+    np.testing.assert_array_equal(stats, stats2)
+
+
+@pytest.mark.slow
+def test_test_splits_rejects_pure_noise():
+    """Null calibration (SURVEY §4 item 3): a Poisson matrix with a fake
+    2-way labelling must collapse to a single cluster."""
+    r = np.random.default_rng(4)
+    counts = r.poisson(3.0, size=(120, 50)).astype(np.float32)
+    pca = r.normal(size=(120, 5)).astype(np.float32)
+    asgn = np.array(["1", "2"] * 60, dtype=object)
+    out = run_test_splits(counts, pca, None, asgn, pc_num=5, k_num=(10,), n_sims=6, max_clusters=32)
+    assert set(out.tolist()) == {"1"}
+
+
+def test_test_splits_keeps_strong_clustering():
+    """Power: well-separated blobs with matching labels pass untouched
+    (silhouette > thresh skips the null fit, reference :907)."""
+    r = np.random.default_rng(5)
+    counts = r.poisson(3.0, size=(120, 50)).astype(np.float32)
+    pca = np.concatenate(
+        [r.normal(0, 0.3, (60, 5)), r.normal(5, 0.3, (60, 5))]
+    ).astype(np.float32)
+    asgn = np.array(["1"] * 60 + ["2"] * 60, dtype=object)
+    out = run_test_splits(counts, pca, None, asgn, pc_num=5, k_num=(10,), n_sims=4, max_clusters=32)
+    assert (out == asgn).all()
+
+
+@pytest.mark.slow
+def test_test_splits_separately_walks_the_tree():
+    """The per-split walk keeps the real top split and collapses fake
+    sub-splits (reference :966-1036 semantics)."""
+    r = np.random.default_rng(6)
+    counts = r.poisson(3.0, size=(120, 50)).astype(np.float32)
+    pca = np.concatenate(
+        [r.normal(0, 0.3, (60, 5)), r.normal(5, 0.3, (60, 5))]
+    ).astype(np.float32)
+    # four leaf clusters: 1/2 inside blob A (fake split), 3/4 inside blob B
+    lab = np.array(["1"] * 30 + ["2"] * 30 + ["3"] * 30 + ["4"] * 30, dtype=object)
+    d = np.sqrt(((pca[:, None, :] - pca[None, :, :]) ** 2).sum(-1))
+    dend = determine_hierarchy(d, lab)
+    out = run_test_splits(
+        counts, pca, dend, lab, pc_num=5, k_num=(10,), n_sims=4,
+        test_separately=True, max_clusters=32,
+    )
+    groups = set(out.tolist())
+    assert len(groups) == 2  # the real blob split survives
+    # every cell keeps its blob
+    assert len(set(out[:60].tolist())) == 1 and len(set(out[60:].tolist())) == 1

@@ -1,0 +1,469 @@
+"""Top-level API (L8): ingestion, orchestration, iteration, output assembly.
+
+Equivalent of the reference's exported ``consensusClust``
+(reference R/consensusClust.R:122-632, SURVEY §3.1/§3.4): validate inputs,
+adapt container objects, normalise + select HVGs + regress, PCA with pcNum
+selection, bootstrap consensus clustering, statistical significance testing,
+optional recursive subclustering, and result assembly (assignments +
+dendrogram + clustree-style hierarchy table).
+
+Division of labor (SURVEY §7.1): everything per-cell/per-gene/per-boot runs on
+device inside the lower layers; this module is the irregular host control —
+adapters, the recursion over clusters (:542-578), label composition
+(parent_child strings, :575-577), and the final dendrogram/hierarchy outputs
+(:580-632).
+
+Input orientation: cells x genes (the AnnData/Python convention), transposed
+from the reference's R genes x cells. Adapters accept dense numpy, scipy
+sparse, or AnnData-like objects (duck-typed on .X/.obs/.var/.obsm/.layers so
+the package has no hard anndata dependency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import ConsensusResult, consensus_cluster
+from consensusclustr_tpu.hierarchy.clustree import hierarchy_edges, hierarchy_table
+from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
+from consensusclustr_tpu.linalg.distance import euclidean_distance_matrix as _euclidean
+from consensusclustr_tpu.linalg.pca import pca_for_config
+from consensusclustr_tpu.nulltest.splits import test_splits
+from consensusclustr_tpu.prep.hvg import select_hvgs
+from consensusclustr_tpu.prep.regress import regress_features
+from consensusclustr_tpu.prep.sizefactors import compute_size_factors
+from consensusclustr_tpu.prep.transform import shifted_log
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import cluster_key, depth_key, root_key
+
+# The significance gate's small-cluster threshold is hardcoded 50 in the
+# reference (:521), independent of the minSize parameter.
+_GATE_SMALL_CLUSTER = 50
+
+
+@dataclasses.dataclass
+class ClusterResult:
+    """Result contract mirroring the reference's return list (:628-632).
+
+    assignments: per-cell lineage labels ("2", "2_1", "2_1_3", ...); all-"1"
+    when no significant structure. cluster_dendrogram: tree over the final
+    labels from co-clustering (bootstrapped) or PCA distances; None for
+    single-cluster results. clustree: hierarchy table + edges (only when
+    iterated with >1 lineage depth, :603-606).
+    """
+
+    assignments: np.ndarray
+    cluster_dendrogram: Optional[Dendrogram] = None
+    clustree: Optional[Dict[str, np.ndarray]] = None
+    clustree_edges: Optional[List[tuple]] = None
+    log: Optional[LevelLog] = None
+
+    @property
+    def n_clusters(self) -> int:
+        return len(set(self.assignments.tolist()))
+
+
+@dataclasses.dataclass
+class _Ingested:
+    """Normalised view of any supported input container."""
+
+    counts: Optional[np.ndarray]          # [n_cells, n_genes] raw counts
+    norm_counts: Optional[np.ndarray]     # [n_cells, n_genes] if provided
+    pca: Optional[np.ndarray]             # [n_cells, d] if provided
+    variable_features: Optional[np.ndarray]  # bool mask [n_genes] or names
+    covariates: Optional[np.ndarray]      # [n_cells, n_cov] float design
+    gene_names: Optional[np.ndarray]
+    scale_data: bool = False              # Seurat scale.data semantics (:223-228)
+
+
+def _densify(x) -> np.ndarray:
+    """Dense float32 array from dense/sparse input."""
+    if hasattr(x, "toarray"):  # scipy sparse
+        x = x.toarray()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _encode_covariates(cols: List[np.ndarray]) -> np.ndarray:
+    """Stack covariate columns, one-hot (drop-first) for non-numeric ones.
+
+    The reference passes metadata columns straight into model.matrix-style
+    lm fits (:209-214, 827-835); numeric columns pass through, factors become
+    dummy indicators.
+    """
+    out = []
+    for col in cols:
+        col = np.asarray(col)
+        if np.issubdtype(col.dtype, np.number):
+            out.append(col.astype(np.float32).reshape(len(col), -1))
+        else:
+            levels = np.unique(col)
+            for lv in levels[1:]:  # drop first level; intercept is implicit
+                out.append((col == lv).astype(np.float32).reshape(-1, 1))
+    if not out:
+        return None
+    return np.concatenate(out, axis=1)
+
+
+def _is_anndata_like(obj) -> bool:
+    return hasattr(obj, "X") and hasattr(obj, "obs") and hasattr(obj, "var")
+
+
+def _ingest_anndata(adata, cfg: ClusterConfig) -> _Ingested:
+    """AnnData adapter, mirroring the Seurat/SCE extraction semantics
+    (reference :198-271, SURVEY §3.2):
+
+      * counts from layers['counts'] when present, else .raw.X, else .X;
+      * norm_counts from layers['logcounts'|'data'] (logcounts == the SCE
+        adapter's source, :265-267);
+      * HVGs from var['highly_variable'] (:199-206, :242-249);
+      * PCA embedding from obsm['X_pca'] (:217-220, :260-262);
+      * vars_to_regress names resolve against obs columns (:209-214, :251-257).
+    """
+    layers = getattr(adata, "layers", {}) or {}
+    counts = None
+    for name in ("counts",):
+        if name in layers:
+            counts = _densify(layers[name])
+            break
+    if counts is None and getattr(adata, "raw", None) is not None:
+        counts = _densify(adata.raw.X)
+    norm = None
+    for name in ("logcounts", "data"):
+        if name in layers:
+            norm = _densify(layers[name])
+            break
+    if counts is None:
+        x = _densify(adata.X)
+        # Heuristic mirrored from Seurat's data-vs-counts fallback (:223-231):
+        # integral non-negative X is counts, otherwise treat as normalised.
+        if np.all(x >= 0) and np.allclose(x, np.round(x)):
+            counts = x
+        else:
+            norm = x if norm is None else norm
+
+    hvg = None
+    if cfg.variable_features is not None:
+        hvg = np.asarray(cfg.variable_features)
+    elif "highly_variable" in getattr(adata, "var", {}):
+        mask = np.asarray(adata.var["highly_variable"], dtype=bool)
+        if mask.any():
+            hvg = mask
+
+    cov = None
+    if cfg.vars_to_regress is not None:
+        if isinstance(cfg.vars_to_regress, (list, tuple)) and all(
+            isinstance(v, str) for v in cfg.vars_to_regress
+        ):
+            cov = _encode_covariates(
+                [np.asarray(adata.obs[v]) for v in cfg.vars_to_regress]
+            )
+        else:
+            cov = np.asarray(cfg.vars_to_regress, dtype=np.float32)
+            cov = cov.reshape(len(cov), -1)
+
+    pca = None
+    obsm = getattr(adata, "obsm", {}) or {}
+    if "X_pca" in obsm:
+        pca = np.asarray(obsm["X_pca"], dtype=np.float32)
+
+    gene_names = None
+    if hasattr(adata, "var_names"):
+        gene_names = np.asarray(adata.var_names)
+    return _Ingested(
+        counts=counts, norm_counts=norm, pca=pca, variable_features=hvg,
+        covariates=cov, gene_names=gene_names,
+    )
+
+
+def _ingest(data, cfg: ClusterConfig, norm_counts=None, pca=None) -> _Ingested:
+    if _is_anndata_like(data):
+        ing = _ingest_anndata(data, cfg)
+        if norm_counts is not None:
+            ing.norm_counts = _densify(norm_counts)
+        if pca is not None:
+            ing.pca = np.asarray(pca, np.float32)
+        return ing
+
+    counts = _densify(data) if data is not None else None
+    cov = None
+    if cfg.vars_to_regress is not None:
+        cov = np.asarray(cfg.vars_to_regress, dtype=np.float32)
+        cov = cov.reshape(len(cov), -1)
+    hvg = np.asarray(cfg.variable_features) if cfg.variable_features is not None else None
+    return _Ingested(
+        counts=counts,
+        norm_counts=_densify(norm_counts) if norm_counts is not None else None,
+        pca=np.asarray(pca, np.float32) if pca is not None else None,
+        variable_features=hvg,
+        covariates=cov,
+        gene_names=None,
+    )
+
+
+def _resolve_hvg_mask(
+    spec: Optional[np.ndarray], gene_names: Optional[np.ndarray], n_genes: int
+) -> Optional[np.ndarray]:
+    """Boolean HVG mask from a mask, an index list, or gene names."""
+    if spec is None:
+        return None
+    spec = np.asarray(spec)
+    if spec.dtype == bool:
+        return spec
+    if np.issubdtype(spec.dtype, np.integer):
+        mask = np.zeros(n_genes, dtype=bool)
+        mask[spec] = True
+        return mask
+    if gene_names is None:
+        raise ValueError("named variable_features need gene names (AnnData input)")
+    return np.isin(gene_names, spec)
+
+
+def _single_cluster(n: int) -> np.ndarray:
+    return np.full(n, "1", dtype=object)
+
+
+def _valid_k(k_num: Sequence[int], n: int) -> Tuple[int, ...]:
+    """Drop neighbourhood sizes that exceed the cell count (the reference's
+    tryCatch would absorb the resulting igraph error into a single-cluster
+    fallback, :392-399; we degrade per-k instead)."""
+    ks = tuple(int(k) for k in k_num if int(k) < n)
+    return ks
+
+
+def _level(
+    key: jax.Array,
+    ing: _Ingested,
+    cfg: ClusterConfig,
+    log: LevelLog,
+    depth: int,
+) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray]]:
+    """One level of the pipeline (reference :274-539): returns
+    (labels [n] of str, consensus result or None, pca or None)."""
+    n = (
+        ing.counts.shape[0]
+        if ing.counts is not None
+        else (ing.norm_counts.shape[0] if ing.norm_counts is not None else ing.pca.shape[0])
+    )
+    log.event("level_start", depth=depth, n_cells=n)
+
+    k_list = _valid_k(cfg.k_num, n)
+    if n < 4 or not k_list:
+        log.event("too_small", n_cells=n)
+        return _single_cluster(n), None, None
+    cfg = cfg.replace(k_num=k_list)
+
+    counts_dev = jnp.asarray(ing.counts, jnp.float32) if ing.counts is not None else None
+    sf = None
+
+    # --- normalise (:274-288) ---------------------------------------------
+    if ing.norm_counts is not None:
+        norm = jnp.asarray(ing.norm_counts, jnp.float32)
+    else:
+        if counts_dev is None:
+            raise ValueError("need counts or norm_counts (or a precomputed pca)")
+        sf = compute_size_factors(counts_dev, cfg.size_factors)
+        norm = shifted_log(counts_dev, sf)
+
+    # --- HVG selection (:291-304) -----------------------------------------
+    n_genes = norm.shape[1]
+    hvg_mask = _resolve_hvg_mask(ing.variable_features, ing.gene_names, n_genes)
+    if hvg_mask is None and not ing.scale_data and counts_dev is not None:
+        n_hvg = min(cfg.n_var_features, n_genes)
+        hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
+    if hvg_mask is not None and not ing.scale_data:
+        # scale.data input skips the HVG subset — Seurat already did (:301)
+        norm = norm[:, np.asarray(hvg_mask)]
+        counts_hvg = (
+            np.asarray(ing.counts)[:, np.asarray(hvg_mask)]
+            if ing.counts is not None
+            else None
+        )
+    else:
+        counts_hvg = np.asarray(ing.counts) if ing.counts is not None else None
+    log.event("prep", n_genes_kept=int(norm.shape[1]))
+
+    # --- covariate regression (:306-319) ----------------------------------
+    skip = cfg.skip_first_regression
+    skip_here = (
+        depth == 1
+        and (skip is True or (not isinstance(skip, bool) and len(skip) > 0))
+    ) or ing.scale_data  # Seurat scale.data is already regressed (:314-319)
+    if ing.covariates is not None and not skip_here:
+        counts_for_glm = (
+            jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None
+        )
+        norm = regress_features(
+            norm, jnp.asarray(ing.covariates, jnp.float32),
+            counts=counts_for_glm, method=cfg.regress_method,
+        )
+        log.event("regressed", method=cfg.regress_method)
+
+    # --- PCA + pcNum (:321-382) -------------------------------------------
+    use_given_pca = (
+        ing.pca is not None
+        and not isinstance(cfg.pc_num, str)
+        and int(cfg.pc_num) <= 30  # quirk 4: provided PCA honored only here
+    )
+    if use_given_pca:
+        pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
+        pca = np.asarray(ing.pca[:, :pc_num], np.float32)
+    else:
+        try:
+            scores, pc_num, _ = pca_for_config(
+                norm, cfg.pc_num, cfg.pc_var,
+                center=cfg.center, scale=cfg.scale,
+                key=cluster_key(key, "pca"),
+                counts=(jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None),
+                size_factors=sf,
+            )
+            pca = np.asarray(scores)
+        except Exception as e:  # PCA failure => single cluster (:368-379)
+            log.event("pca_failed", error=str(e))
+            return _single_cluster(n), None, None
+        if not np.all(np.isfinite(pca)):
+            log.event("pca_failed", error="non-finite scores")
+            return _single_cluster(n), None, None
+    log.event("pca", pc_num=int(pc_num))
+
+    # --- consensus clustering (L5, :388-511) ------------------------------
+    cons = consensus_cluster(cluster_key(key, "consensus"), pca, cfg, log=log)
+    labels = np.asarray([str(l + 1) for l in cons.labels], dtype=object)
+
+    # --- significance gate (:514-539) -------------------------------------
+    sizes = np.unique(cons.labels, return_counts=True)[1]
+    any_small = bool((sizes < _GATE_SMALL_CLUSTER).any())  # quirk 7: "any"
+    if len(sizes) > 1 and (cons.silhouette <= cfg.silhouette_thresh or any_small):
+        if counts_hvg is None:
+            log.event("null_test_skipped", reason="no raw counts available")
+        else:
+            dend = determine_hierarchy(_euclidean(pca), labels)
+            labels = test_splits(
+                counts_hvg, pca, dend, labels,
+                pc_num=int(pc_num), k_num=cfg.k_num, alpha=cfg.alpha,
+                silhouette_thresh=cfg.silhouette_thresh,
+                covariates=ing.covariates, n_sims=cfg.n_null_sims,
+                key=cluster_key(key, "nulltest"),
+                test_separately=cfg.test_splits_separately,
+                max_clusters=cfg.max_clusters, log=log,
+            )
+            labels = _relabel(labels)
+    log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))
+    return labels, cons, pca
+
+
+def _relabel(labels: np.ndarray) -> np.ndarray:
+    """Compact surviving labels to "1".."C" in first-seen order (the reference
+    re-factors assignments after merges)."""
+    labels = np.asarray(labels, dtype=object)
+    mapping: Dict[Any, str] = {}
+    out = np.empty(len(labels), dtype=object)
+    for i, l in enumerate(labels):
+        if l not in mapping:
+            mapping[l] = str(len(mapping) + 1)
+        out[i] = mapping[l]
+    return out
+
+
+def _iterate(
+    key: jax.Array,
+    counts: np.ndarray,
+    covariates: Optional[np.ndarray],
+    labels: np.ndarray,
+    cfg: ClusterConfig,
+    log: LevelLog,
+    depth: int,
+) -> np.ndarray:
+    """Recursive subclustering (reference :542-578): re-run the full pipeline
+    inside each surviving cluster with > min_size cells, HVGs and PCs
+    recomputed per cluster, labels composed parent_child."""
+    out = labels.copy()
+    uniq = sorted(set(labels.tolist()), key=str)
+    for ci, parent in enumerate(uniq):
+        mask = labels == parent
+        n_c = int(mask.sum())
+        if n_c <= cfg.min_size:
+            continue
+        sub_cfg = cfg.replace(variable_features=None, depth=depth + 1)
+        sub_ing = _Ingested(
+            counts=counts[mask],
+            norm_counts=None, pca=None, variable_features=None,
+            covariates=covariates[mask] if covariates is not None else None,
+            gene_names=None,
+        )
+        sub_key = depth_key(key, depth + 1, ci)
+        sub_log = log.child()
+        try:
+            child, _, _ = _level(sub_key, sub_ing, sub_cfg, sub_log, depth + 1)
+            if len(set(child.tolist())) > 1:
+                child = _iterate(
+                    sub_key, counts[mask],
+                    covariates[mask] if covariates is not None else None,
+                    child, sub_cfg, sub_log, depth + 1,
+                )
+        except Exception as e:
+            # failed child => parent keeps its label (reference sentinel :572,
+            # rebuilt as an explicit status per quirks item 12)
+            log.event("subcluster_failed", parent=str(parent), error=str(e))
+            continue
+        if len(set(child.tolist())) > 1:
+            out[mask] = np.asarray(
+                [f"{parent}_{c}" for c in child], dtype=object
+            )
+    return out
+
+
+def consensus_clust(
+    counts=None,
+    *,
+    norm_counts=None,
+    pca=None,
+    config: Optional[ClusterConfig] = None,
+    **params,
+) -> ClusterResult:
+    """Bootstrapped consensus clustering with statistical significance testing.
+
+    Public API mirroring the reference export (NAMESPACE:3; :122). `counts`
+    may be a dense [n_cells, n_genes] array, scipy sparse matrix, or an
+    AnnData-like object; keyword `params` mirror the reference's arguments
+    snake_cased (see ClusterConfig).
+
+    Returns ClusterResult(assignments, cluster_dendrogram, clustree) per the
+    reference's result contract (SURVEY §8.3).
+    """
+    cfg = (config or ClusterConfig()).replace(**params) if params else (config or ClusterConfig())
+    log = LevelLog(enabled=cfg.progress)
+    key = root_key(cfg.seed)
+
+    ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
+    labels, cons, pca_used = _level(key, ing, cfg, log, depth=cfg.depth)
+    n = len(labels)
+
+    if cfg.iterate and len(set(labels.tolist())) > 1 and ing.counts is not None:
+        labels = _iterate(key, ing.counts, ing.covariates, labels, cfg, log, cfg.depth)
+
+    # --- output assembly at depth 1 (:580-632) ----------------------------
+    dend = None
+    if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
+        dist = cons.jaccard_dist if cons.jaccard_dist is not None else _euclidean(pca_used)
+        dend = determine_hierarchy(dist, labels)
+    elif len(set(labels.tolist())) <= 1:
+        log.event("failed_test")  # the reference's message("Failed Test") :613
+
+    tree = edges = None
+    if cfg.iterate and any("_" in str(l) for l in labels):
+        tree = hierarchy_table(labels)
+        edges = hierarchy_edges(labels)
+
+    return ClusterResult(
+        assignments=labels,
+        cluster_dendrogram=dend,
+        clustree=tree,
+        clustree_edges=edges,
+        log=log,
+    )

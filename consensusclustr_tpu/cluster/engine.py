@@ -36,6 +36,14 @@ class GridResult(NamedTuple):
     scores: jax.Array      # [n_cand] float32
 
 
+def ties_last_argmax(scores: jax.Array) -> jax.Array:
+    """argmax taking the LAST tied maximum — the selection R's
+    rank(ties.method="first") induces in the reference's robust-mode pick
+    (:685): the max rank lands on the last occurrence of the max score."""
+    r = scores.shape[0]
+    return (r - 1 - jnp.argmax(scores[::-1])).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("max_clusters",))
 def candidate_score(
     x: jax.Array,

@@ -137,7 +137,12 @@ def merge_unstable_clusters(
         if sm[a, b] >= min_stability:
             return consensus
         if a != b:
-            # reference :487: cells of the col cluster move to the row cluster
-            consensus[consensus == b] = a
+            # reference :487: cells of the col cluster move to the row
+            # cluster. R's which(arr.ind=TRUE) is column-major, so its first
+            # hit on the symmetric min pair (i<j) is row=j, col=i — the
+            # SMALLER id is absorbed into the LARGER. Direction matters under
+            # the stale-matrix rescan: later minima may reference the dead id.
+            lo, hi = (a, b) if a < b else (b, a)
+            consensus[consensus == lo] = hi
         sm[a, b] = 1.0
         sm[b, a] = 1.0

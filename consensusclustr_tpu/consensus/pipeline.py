@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensusclustr_tpu.config import ClusterConfig
-from consensusclustr_tpu.cluster.engine import align_to_cells, cluster_grid
+from consensusclustr_tpu.cluster.engine import (
+    align_to_cells,
+    cluster_grid,
+    ties_last_argmax as _ties_last_argmax,
+)
 from consensusclustr_tpu.cluster.knn import knn_from_distance
 from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
@@ -50,11 +54,6 @@ class ConsensusResult(NamedTuple):
     jaccard_dist: Optional[np.ndarray]  # [n, n] co-clustering distance (None if nboots<=1)
     boot_labels: Optional[np.ndarray]   # [B(,*K*R), n] aligned boot assignments
     n_clusters: int
-
-
-def _ties_last_argmax(scores: jax.Array) -> jax.Array:
-    r = scores.shape[0]
-    return (r - 1 - jnp.argmax(scores[::-1])).astype(jnp.int32)
 
 
 @functools.partial(

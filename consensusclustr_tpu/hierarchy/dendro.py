@@ -82,6 +82,10 @@ class Dendrogram:
         height (intent per SURVEY §7.3 item 6 / quirks ledger)."""
         sps = self.cophenetic_heights()
         top = float(sps.max())
+        if top <= 0.0:
+            # degenerate tree (all merge heights 0, e.g. duplicate rows):
+            # cut at 0 => one branch, which callers treat as "no split"
+            return 0.0
         sel = float(sps[sps > 0.85 * top].min())
         h = float(np.floor(sel))
         if not (sps.min() <= h < top):

@@ -1,0 +1,19 @@
+"""Pairwise distance helpers shared by the API and split-testing layers.
+
+The reference computes Euclidean cell-cell distances with stats::dist
+(reference R/consensusClust.R:510, :523, :987); here the host-side numpy
+variant serves the tiny irregular paths while the big O(n^2) passes stay on
+device (consensus.cocluster, cluster.knn).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def euclidean_distance_matrix(x: np.ndarray) -> np.ndarray:
+    """[n, n] Euclidean distances from an [n, d] embedding."""
+    x = np.asarray(x)
+    sq = np.sum(x * x, axis=1)
+    d2 = sq[:, None] - 2.0 * (x @ x.T) + sq[None, :]
+    return np.sqrt(np.maximum(d2, 0.0))

@@ -24,7 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensusclustr_tpu.config import NULL_SIM_MIN_SIZE, NULL_SIM_RES_RANGE
-from consensusclustr_tpu.cluster.engine import cluster_grid
+from consensusclustr_tpu.cluster.engine import (
+    cluster_grid,
+    ties_last_argmax as _ties_last_argmax,
+)
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.linalg.pca import truncated_pca
 from consensusclustr_tpu.nulltest.copula import CopulaModel, simulate_counts
@@ -36,11 +39,6 @@ from consensusclustr_tpu.prep.sizefactors import (
 )
 from consensusclustr_tpu.prep.transform import shifted_log
 from consensusclustr_tpu.utils.rng import sim_key
-
-
-def _ties_last_argmax(scores: jax.Array) -> jax.Array:
-    r = scores.shape[0]
-    return (r - 1 - jnp.argmax(scores[::-1])).astype(jnp.int32)
 
 
 @functools.partial(

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
-from consensusclustr_tpu.hierarchy.dendro import Dendrogram
+from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
+from consensusclustr_tpu.linalg.distance import euclidean_distance_matrix as _euclidean
 from consensusclustr_tpu.nulltest.copula import fit_nb_copula
 from consensusclustr_tpu.nulltest.null import generate_null_statistics
 from consensusclustr_tpu.utils.log import LevelLog
@@ -184,12 +185,6 @@ def _branch_structures(pca, dend, labels, max_clusters):
         else 1.0
     )
     return h, branch_of, branch_codes, sil
-
-
-def _euclidean(pca: np.ndarray) -> np.ndarray:
-    sq = np.sum(pca * pca, axis=1)
-    d2 = sq[:, None] - 2.0 * (pca @ pca.T) + sq[None, :]
-    return np.sqrt(np.maximum(d2, 0.0))
 
 
 def _test_tree(

@@ -157,3 +157,32 @@ def test_consensus_deterministic_across_chunk_sizes():
     r1 = consensus_cluster(root_key(3), x, _small_cfg(boot_batch=2))
     r2 = consensus_cluster(root_key(3), x, _small_cfg(boot_batch=8))
     np.testing.assert_array_equal(r1.labels, r2.labels)
+
+
+def test_merge_unstable_direction_column_major():
+    """Stale-matrix merge direction parity (reference :487): the smaller id is
+    absorbed into the larger, so chained stale minima collapse fully.
+
+    With pairs {0,1}=0.05 and {1,2}=0.10 below threshold the reference ends in
+    ONE cluster (0->1 then 1->2); the inverted direction would strand cluster
+    2's cells on the dead label and end in two."""
+    cons = np.asarray([0, 0, 1, 1, 2, 2], np.int32)
+    boots = np.tile(cons, (4, 1))
+
+    import consensusclustr_tpu.consensus.merge as m
+
+    orig = m.stability_matrix
+
+    def fake_stability(consensus, boot_labels, max_clusters, max_boot_clusters=64):
+        sm = np.ones((max_clusters, max_clusters), np.float32)
+        sm[0, 1] = sm[1, 0] = 0.05
+        sm[1, 2] = sm[2, 1] = 0.10
+        return jnp.asarray(sm)
+
+    m.stability_matrix = fake_stability
+    try:
+        merged = merge_unstable_clusters(cons, boots, 0.175, 4)
+    finally:
+        m.stability_matrix = orig
+    assert len(np.unique(merged)) == 1
+    assert merged[0] == 2

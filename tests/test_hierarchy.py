@@ -86,3 +86,15 @@ def test_hierarchy_edges():
     assert ("2", "2_1", 2) in edges
     assert ("2_1", "2_1_3", 1) in edges
     assert ("2", "2_2", 1) in edges
+
+
+def test_degenerate_zero_height_tree_no_crash():
+    """All-zero merge heights (duplicate rows) must degrade to 'no split',
+    not crash first_split_height (reference's max(1, which(...)) guard)."""
+    dist = np.zeros((6, 6))
+    labels = np.asarray(["1", "1", "2", "2", "3", "3"], dtype=object)
+    dend = determine_hierarchy(dist, labels)
+    h = dend.first_split_height()
+    assert h == 0.0
+    memb = dend.cut_memberships(h)
+    assert len(np.unique(memb)) == 1

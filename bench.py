@@ -1,0 +1,83 @@
+"""Benchmark harness: bootstraps/sec through the consensus inner loop.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The tracked metric is BASELINE.md's bootstraps/sec: full bootstrap grid
+clusterings (kNN -> SNN -> Leiden over the (k, resolution) grid + silhouette
+selection + alignment) plus the co-clustering distance accumulation — the
+reference's hot loops 1-2 (R/consensusClust.R:388-421, SURVEY §3.1).
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+against the driver's north star rate: 1000 bootstraps x 12 resolutions on 50k
+cells in <60 s => 16.67 boots/sec (BASELINE.json:5). vs_baseline > 1 beats it.
+
+Env knobs: BENCH_CELLS, BENCH_BOOTS, BENCH_RES, BENCH_PCS (defaults scale with
+the backend: accelerator vs CPU smoke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+NORTH_STAR_BOOTS_PER_SEC = 1000.0 / 60.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.consensus.cocluster import coclustering_distance
+    from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+    from consensusclustr_tpu.utils.rng import root_key
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    n = int(os.environ.get("BENCH_CELLS", 10_000 if on_accel else 512))
+    nboots = int(os.environ.get("BENCH_BOOTS", 24 if on_accel else 8))
+    n_res = int(os.environ.get("BENCH_RES", 12))
+    d = int(os.environ.get("BENCH_PCS", 20))
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0.0, 6.0, size=(8, d))
+    pca = (
+        centers[rng.integers(0, 8, size=n)] + rng.normal(0, 1.0, size=(n, d))
+    ).astype(np.float32)
+
+    res_range = tuple(float(r) for r in np.linspace(0.05, 1.5, n_res))
+    cfg = ClusterConfig(
+        nboots=nboots, res_range=res_range, k_num=(10, 15, 20), max_clusters=64
+    )
+    key = root_key(123)
+    pca_dev = jnp.asarray(pca)
+
+    def run():
+        labels, _ = run_bootstraps(key, pca_dev, cfg)
+        dist = coclustering_distance(jnp.asarray(labels, jnp.int32), cfg.max_clusters)
+        return jax.block_until_ready(dist)
+
+    run()  # warmup: compiles the exact chunk shapes the timed run uses
+
+    t0 = time.perf_counter()
+    run()
+    dt = time.perf_counter() - t0
+    boots_per_sec = nboots / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": f"bootstraps/sec ({n} cells, {n_res} res, k=3, to consensus matrix)",
+                "value": round(boots_per_sec, 3),
+                "unit": "boots/s",
+                "vs_baseline": round(boots_per_sec / NORTH_STAR_BOOTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -134,10 +134,17 @@ def _ingest_anndata(adata, cfg: ClusterConfig) -> _Ingested:
     if counts is None and getattr(adata, "raw", None) is not None:
         counts = _densify(adata.raw.X)
     norm = None
-    for name in ("logcounts", "data"):
-        if name in layers:
-            norm = _densify(layers[name])
-            break
+    scale_data = False
+    if "scale_data" in layers:
+        # Seurat scale.data semantics (:223-228): already HVG-subset and
+        # regressed, so _level skips both steps downstream
+        norm = _densify(layers["scale_data"])
+        scale_data = True
+    else:
+        for name in ("logcounts", "data"):
+            if name in layers:
+                norm = _densify(layers[name])
+                break
     if counts is None:
         x = _densify(adata.X)
         # Heuristic mirrored from Seurat's data-vs-counts fallback (:223-231):
@@ -177,7 +184,7 @@ def _ingest_anndata(adata, cfg: ClusterConfig) -> _Ingested:
         gene_names = np.asarray(adata.var_names)
     return _Ingested(
         counts=counts, norm_counts=norm, pca=pca, variable_features=hvg,
-        covariates=cov, gene_names=gene_names,
+        covariates=cov, gene_names=gene_names, scale_data=scale_data,
     )
 
 
@@ -228,6 +235,26 @@ def _single_cluster(n: int) -> np.ndarray:
     return np.full(n, "1", dtype=object)
 
 
+def _skip_first_regression(cfg: ClusterConfig, ing: "_Ingested") -> bool:
+    """First-level regression gating (reference :306-319): True, or a list of
+    covariate names that must cover ALL of vars_to_regress for the skip to
+    apply (the reference's `!all(colnames %in% skipFirstRegression)` test)."""
+    skip = cfg.skip_first_regression
+    if isinstance(skip, bool):
+        return skip
+    names = (
+        list(cfg.vars_to_regress)
+        if isinstance(cfg.vars_to_regress, (list, tuple))
+        and all(isinstance(v, str) for v in cfg.vars_to_regress)
+        else None
+    )
+    if names is None:
+        # covariates given as a raw design matrix: any non-empty skip list
+        # can only mean "skip" (there are no names to match)
+        return len(list(skip)) > 0
+    return len(list(skip)) > 0 and all(v in list(skip) for v in names)
+
+
 def _valid_k(k_num: Sequence[int], n: int) -> Tuple[int, ...]:
     """Drop neighbourhood sizes that exceed the cell count (the reference's
     tryCatch would absorb the resulting igraph error into a single-cluster
@@ -261,24 +288,42 @@ def _level(
     counts_dev = jnp.asarray(ing.counts, jnp.float32) if ing.counts is not None else None
     sf = None
 
+    # Provided-PCA gate, decided up front: when honored, the whole
+    # normalise/regress chain would only feed a PCA we never compute, so it
+    # is skipped (its other consumer, the null test, needs raw HVG counts
+    # only). Quirk 4: object/user PCA is honored iff pc_num is numeric <= 30.
+    use_given_pca = (
+        ing.pca is not None
+        and not isinstance(cfg.pc_num, str)
+        and int(cfg.pc_num) <= 30
+    )
+
     # --- normalise (:274-288) ---------------------------------------------
-    if ing.norm_counts is not None:
+    if use_given_pca:
+        norm = None
+    elif ing.norm_counts is not None:
         norm = jnp.asarray(ing.norm_counts, jnp.float32)
     else:
         if counts_dev is None:
-            raise ValueError("need counts or norm_counts (or a precomputed pca)")
+            raise ValueError(
+                "need counts or norm_counts (or a precomputed pca with a "
+                "numeric pc_num <= 30)"
+            )
         sf = compute_size_factors(counts_dev, cfg.size_factors)
         norm = shifted_log(counts_dev, sf)
 
     # --- HVG selection (:291-304) -----------------------------------------
-    n_genes = norm.shape[1]
+    n_genes = ing.counts.shape[1] if ing.counts is not None else (
+        norm.shape[1] if norm is not None else 0
+    )
     hvg_mask = _resolve_hvg_mask(ing.variable_features, ing.gene_names, n_genes)
     if hvg_mask is None and not ing.scale_data and counts_dev is not None:
         n_hvg = min(cfg.n_var_features, n_genes)
         hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
     if hvg_mask is not None and not ing.scale_data:
         # scale.data input skips the HVG subset — Seurat already did (:301)
-        norm = norm[:, np.asarray(hvg_mask)]
+        if norm is not None:
+            norm = norm[:, np.asarray(hvg_mask)]
         counts_hvg = (
             np.asarray(ing.counts)[:, np.asarray(hvg_mask)]
             if ing.counts is not None
@@ -286,15 +331,13 @@ def _level(
         )
     else:
         counts_hvg = np.asarray(ing.counts) if ing.counts is not None else None
-    log.event("prep", n_genes_kept=int(norm.shape[1]))
+    log.event("prep", n_genes_kept=int(norm.shape[1]) if norm is not None else 0)
 
     # --- covariate regression (:306-319) ----------------------------------
-    skip = cfg.skip_first_regression
     skip_here = (
-        depth == 1
-        and (skip is True or (not isinstance(skip, bool) and len(skip) > 0))
+        depth == 1 and _skip_first_regression(cfg, ing)
     ) or ing.scale_data  # Seurat scale.data is already regressed (:314-319)
-    if ing.covariates is not None and not skip_here:
+    if ing.covariates is not None and norm is not None and not skip_here:
         counts_for_glm = (
             jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None
         )
@@ -305,11 +348,6 @@ def _level(
         log.event("regressed", method=cfg.regress_method)
 
     # --- PCA + pcNum (:321-382) -------------------------------------------
-    use_given_pca = (
-        ing.pca is not None
-        and not isinstance(cfg.pc_num, str)
-        and int(cfg.pc_num) <= 30  # quirk 4: provided PCA honored only here
-    )
     if use_given_pca:
         pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
         pca = np.asarray(ing.pca[:, :pc_num], np.float32)

@@ -53,6 +53,13 @@ def _local_moves(
     two_m = jnp.maximum(two_m, 1e-12)
     node_ids = jnp.arange(n, dtype=jnp.int32)
     resolution = jnp.asarray(resolution, jnp.float32)
+    # scan-vma: the carry must carry the union of the graph's and the key's
+    # varying-manual-axes types (inside shard_map either may be sharded)
+    labels0 = (
+        labels0
+        + nbr[0, 0] * 0
+        + jnp.asarray(jax.random.key_data(key).ravel()[0], jnp.int32) * 0
+    )
 
     def body(carry, it_key):
         labels = carry
@@ -113,7 +120,8 @@ def _merge_communities(
     big_w = big_w.reshape(k_coarse, k_coarse)
     k_deg = jnp.zeros((k_coarse,), jnp.float32).at[compact].add(deg)
     active0 = jnp.zeros((k_coarse,), bool).at[compact].set(True)
-    ids = jnp.arange(k_coarse, dtype=jnp.int32)
+    # varying-typed iota: see leiden_fixed's scan-vma note
+    ids = jnp.arange(k_coarse, dtype=jnp.int32) + compact[0] * 0
 
     def round_fn(carry, _):
         big_w_, k_deg_, active, assign = carry
@@ -159,8 +167,11 @@ def leiden_fixed(
     resolution = jnp.asarray(resolution, jnp.float32)
     n = graph.nbr.shape[0]
     k1, k2 = jax.random.split(key)
+    # `+ nbr[0,0]*0` inherits the graph's varying-manual-axes type, so the
+    # scan carry typechecks when this runs inside shard_map (scan-vma rule).
+    singletons = jnp.arange(n, dtype=jnp.int32) + graph.nbr[0, 0] * 0
     labels = _local_moves(
-        k1, graph, jnp.arange(n, dtype=jnp.int32), resolution, n_iters, update_frac
+        k1, graph, singletons, resolution, n_iters, update_frac
     )
     kc = min(k_coarse, n)
     labels = _merge_communities(labels, graph, resolution, kc, merge_rounds)

@@ -21,6 +21,7 @@ fallback (SURVEY §5 failure-detection row).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -98,13 +99,13 @@ def _auto_boot_chunk(
     if requested > 0:
         return max(1, min(requested, nboots))
     # Bound the per-chunk workspace: the kNN m x m distance pass plus the
-    # Leiden local-move gain tensor [n_res, m, e, e+2] (e = 2k edge slots).
-    # The axon TPU runtime hard-crashes (not OOMs gracefully) when pushed, so
-    # stay well under HBM: ~256 MB of tracked workspace per chunk.
+    # Leiden local-move gain tensor [n_res, m, e, e+2] float32 (e = 2k edge
+    # slots). The TPU runtime hard-crashes (not OOMs gracefully) when pushed,
+    # so track ~2 GB of workspace per chunk against the 16 GB HBM.
     e = 2 * k_max
     per_boot = m * m * 4.0 + n_res * m * e * (e + 2) * 4.0
-    budget = 2.5e8
-    return int(max(1, min(nboots, budget // max(per_boot, 1.0), 32)))
+    budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9))
+    return int(max(1, min(nboots, budget // max(per_boot, 1.0), 64)))
 
 
 def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None):

@@ -1,0 +1,35 @@
+"""Distributed execution layer: device meshes, sharded kernels, collectives.
+
+The TPU counterpart of the reference's BiocParallel process pools + OpenMP
+threads (SURVEY §2.4): shard_map over a ("boot", "cell") Mesh, psum for the
+co-clustering counts, ppermute for the ring kNN.
+"""
+
+from consensusclustr_tpu.parallel.mesh import (
+    BOOT_AXIS,
+    CELL_AXIS,
+    consensus_mesh,
+    factor_devices,
+)
+from consensusclustr_tpu.parallel.boots import sharded_run_bootstraps
+from consensusclustr_tpu.parallel.cocluster import sharded_coclustering_distance
+from consensusclustr_tpu.parallel.knn import ring_knn, sharded_knn_from_distance
+from consensusclustr_tpu.parallel.step import (
+    DistributedStepResult,
+    distributed_consensus_cluster,
+    distributed_consensus_step,
+)
+
+__all__ = [
+    "BOOT_AXIS",
+    "CELL_AXIS",
+    "consensus_mesh",
+    "factor_devices",
+    "sharded_run_bootstraps",
+    "sharded_coclustering_distance",
+    "ring_knn",
+    "sharded_knn_from_distance",
+    "DistributedStepResult",
+    "distributed_consensus_cluster",
+    "distributed_consensus_step",
+]

@@ -1,0 +1,78 @@
+"""Bootstrap fan-out sharded over the device mesh.
+
+Distributed form of consensus/pipeline.py's ``run_bootstraps`` — the TPU
+counterpart of the reference's `bplapply(1:nboots)` worker pool
+(reference R/consensusClust.R:388-400; SURVEY §2.4 row 1): bootstraps are
+data-parallel over the mesh's "boot" axis; the PCA matrix is replicated (it is
+small — n x pcNum); each device runs the full kNN->SNN->Leiden grid for its
+local bootstraps via the same jitted kernels as the single-chip path.
+
+Like the reference's share-nothing workers, no communication happens here —
+the assignments stay boot-sharded and flow straight into the sharded
+co-clustering psum (parallel/cocluster.py).
+
+Determinism: per-boot keys are folded from the global boot id (utils/rng.py),
+so assignments are bit-identical regardless of mesh shape.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from consensusclustr_tpu.cluster.engine import (
+    align_to_cells,
+    cluster_grid,
+    ties_last_argmax,
+)
+from consensusclustr_tpu.parallel.mesh import BOOT_AXIS
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "k_list", "max_clusters", "n_iters", "n_cells"),
+)
+def sharded_run_bootstraps(
+    keys: jax.Array,       # [B] per-boot PRNG keys
+    idx: jax.Array,        # [B, m] int32 bootstrap gathers
+    pca: jax.Array,        # [n, d] float32, replicated
+    res_list: jax.Array,   # [R]
+    mesh: jax.sharding.Mesh,
+    k_list: Tuple[int, ...],
+    max_clusters: int,
+    n_cells: int,
+    n_iters: int = 20,
+) -> Tuple[jax.Array, jax.Array]:
+    """Robust-mode bootstraps over the mesh.
+
+    Returns (labels [B, n] int32 with -1 for unsampled, scores [B]), sharded
+    over the "boot" mesh axis. B must divide by the boot axis extent.
+    """
+    if idx.shape[0] % mesh.shape[BOOT_AXIS]:
+        raise ValueError(
+            f"B={idx.shape[0]} not divisible by boot axis {mesh.shape[BOOT_AXIS]}"
+        )
+
+    def kernel(keys_local, idx_local, pca_rep, res_rep):
+        def one(key_b, idx_b):
+            x = pca_rep[idx_b]
+            grid = cluster_grid(
+                key_b, x, res_rep, k_list, jnp.float32(0.0),
+                max_clusters=max_clusters, n_iters=n_iters,
+            )
+            best = ties_last_argmax(grid.scores)
+            aligned = align_to_cells(grid.labels[best], idx_b, n_cells)
+            return aligned, grid.scores[best]
+
+        return jax.vmap(one)(keys_local, idx_local)
+
+    return jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(BOOT_AXIS), P(BOOT_AXIS, None), P(None, None), P(None)),
+        out_specs=(P(BOOT_AXIS, None), P(BOOT_AXIS)),
+    )(keys, idx, jnp.asarray(pca, jnp.float32), jnp.asarray(res_list, jnp.float32))

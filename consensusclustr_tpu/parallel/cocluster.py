@@ -1,0 +1,116 @@
+"""Sharded co-clustering (consensus Jaccard) distance.
+
+Distributed form of consensus/cocluster.py — the TPU equivalent of the
+reference's OpenMP parDist pass over the inline Armadillo kernel
+(reference R/consensusClust.R:411-421). Sharding layout (SURVEY §2.4 /
+§5 long-context row):
+
+  * the boot axis of ``labels [B, n]`` is sharded over mesh axis "boot";
+  * the *rows* of the n x n agree/union accumulators are sharded over mesh
+    axis "cell", so no device ever materialises the full matrix;
+  * each (boot-shard, cell-shard) device computes its partial
+    ``agree[rows_block, :]`` from its local bootstraps as a batched matmul on
+    the MXU, then one ``psum`` over "boot" completes the counts — the single
+    true all-reduce in the whole design.
+
+At 1M cells (BASELINE.json config 5) the full float32 matrix is 4 TB; the
+row-sharded blocks at cell=8 are 500 GB/device-row — still too big to hold,
+which is why the distributed step (parallel/step.py) immediately reduces each
+row block to its top-k neighbours and never keeps the dense block.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
+
+
+def _partial_counts(
+    labels_local: jax.Array,   # [B_loc, n] int32, -1 = unsampled
+    row_start: jax.Array,      # scalar int32: first row of this device's block
+    n_rows: int,
+    max_clusters: int,
+    chunk: int,
+    vary_axes: Tuple[str, ...] = (),
+) -> Tuple[jax.Array, jax.Array]:
+    """(agree, union) [n_rows, n] from this device's local bootstraps."""
+    b, n = labels_local.shape
+    pad = (-b) % chunk
+    if pad:
+        labels_local = jnp.concatenate(
+            [labels_local, jnp.full((pad, n), -1, jnp.int32)], axis=0
+        )
+    labels_local = labels_local.reshape(-1, chunk, n)
+    cvals = jnp.arange(max_clusters, dtype=jnp.int32)
+
+    def body(carry, chunk_labels):
+        agree, union = carry
+        valid = (chunk_labels >= 0).astype(jnp.bfloat16)                 # [c, n]
+        onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)
+        onehot = onehot * valid[:, :, None]                               # [c, n, C]
+        rows = jax.lax.dynamic_slice_in_dim(onehot, row_start, n_rows, axis=1)
+        vrows = jax.lax.dynamic_slice_in_dim(valid, row_start, n_rows, axis=1)
+        agree = agree + jnp.einsum(
+            "cik,cjk->ij", rows, onehot, preferred_element_type=jnp.float32
+        )
+        union = union + jnp.einsum(
+            "ci,cj->ij", vrows, valid, preferred_element_type=jnp.float32
+        )
+        return (agree, union), None
+
+    zero = jnp.zeros((n_rows, n), jnp.float32)
+    if vary_axes:  # inside shard_map the carry must match the body's vma type
+        zero = jax.lax.pcast(zero, vary_axes, to="varying")
+    (agree, union), _ = jax.lax.scan(body, (zero, zero), labels_local)
+    return agree, union
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "max_clusters", "chunk"))
+def sharded_coclustering_distance(
+    labels: jax.Array,
+    mesh: jax.sharding.Mesh,
+    max_clusters: int = 64,
+    chunk: int = 8,
+) -> jax.Array:
+    """labels: [B, n] int32 (-1 = unsampled). Returns the [n, n] float32
+    co-clustering distance, row-sharded over the mesh's "cell" axis.
+
+    Requires B % mesh["boot"] == 0 and n % mesh["cell"] == 0 (pad bootstraps
+    with all -1 rows — they contribute nothing — and pick n accordingly; the
+    host wrappers handle boot padding).
+    """
+    b, n = labels.shape
+    n_cell = mesh.shape[CELL_AXIS]
+    if n % n_cell:
+        raise ValueError(f"n={n} not divisible by cell axis {n_cell}")
+    if b % mesh.shape[BOOT_AXIS]:
+        raise ValueError(f"B={b} not divisible by boot axis {mesh.shape[BOOT_AXIS]}")
+    n_rows = n // n_cell
+
+    def kernel(labels_local):
+        row_start = jax.lax.axis_index(CELL_AXIS).astype(jnp.int32) * n_rows
+        agree, union = _partial_counts(
+            labels_local, row_start, n_rows, max_clusters, chunk,
+            vary_axes=(BOOT_AXIS, CELL_AXIS),
+        )
+        agree = jax.lax.psum(agree, BOOT_AXIS)
+        union = jax.lax.psum(union, BOOT_AXIS)
+        jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
+        dist = 1.0 - jac
+        # zero the diagonal of this row block
+        rows = row_start + jnp.arange(n_rows)
+        dist = dist.at[jnp.arange(n_rows), rows].set(0.0)
+        return dist
+
+    return jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=P(BOOT_AXIS, None),
+        out_specs=P(CELL_AXIS, None),
+    )(jnp.asarray(labels, jnp.int32))

@@ -1,0 +1,125 @@
+"""Distributed k-nearest-neighbour search.
+
+Two regimes (SURVEY §5 long-context row — the cell dimension is this
+framework's seq-length analog, and the remedies mirror ring attention):
+
+* ``sharded_knn_from_distance`` — the consensus path (reference
+  R/consensusClust.R:425): the distance matrix is already row-sharded over the
+  mesh's "cell" axis (parallel/cocluster.py), so each device takes a local
+  ``top_k`` over its row block; no communication at all.
+
+* ``ring_knn`` — the raw-point path for cell counts where even one n x n tile
+  pass per device is too big to hold against a replicated point set: the point
+  set is sharded over "cell", and block tiles circulate around the ring via
+  ``ppermute`` (one hop per step, bandwidth rides ICI) while every device
+  maintains a running top-k merge of its rows against each arriving tile —
+  exactly ring attention's schedule with (distance, top-k-merge) in place of
+  (logits, softmax-accumulate).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from consensusclustr_tpu.parallel.mesh import CELL_AXIS
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k"))
+def sharded_knn_from_distance(
+    dist: jax.Array,            # [n, n] row-sharded over "cell"
+    mesh: jax.sharding.Mesh,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k per row of a row-sharded distance matrix (self excluded).
+
+    Returns (idx [n, k] int32, dist [n, k]) sharded the same way as the input
+    rows. Pure local compute: columns are complete within each row block.
+    """
+    n = dist.shape[0]
+    n_cell = mesh.shape[CELL_AXIS]
+    n_rows = n // n_cell
+
+    def kernel(block):
+        row_start = jax.lax.axis_index(CELL_AXIS).astype(jnp.int32) * n_rows
+        rows = row_start + jnp.arange(n_rows)
+        d = block.at[jnp.arange(n_rows), rows].set(jnp.inf)
+        neg, idx = jax.lax.top_k(-d, k)
+        return idx.astype(jnp.int32), -neg
+
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=P(CELL_AXIS, None),
+        out_specs=(P(CELL_AXIS, None), P(CELL_AXIS, None)),
+    )(dist)
+
+
+def _merge_topk(
+    best_d: jax.Array, best_i: jax.Array, cand_d: jax.Array, cand_i: jax.Array, k: int
+):
+    """Merge two (dist, idx) candidate sets into the k smallest per row."""
+    d = jnp.concatenate([best_d, cand_d], axis=1)
+    i = jnp.concatenate([best_i, cand_i], axis=1)
+    neg, pos = jax.lax.top_k(-d, k)
+    return -neg, jnp.take_along_axis(i, pos, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k"))
+def ring_knn(
+    x: jax.Array,               # [n, d] row-sharded over "cell"
+    mesh: jax.sharding.Mesh,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact Euclidean kNN of a point set sharded over the "cell" axis.
+
+    Returns (idx [n, k] int32 into the global point order, dist [n, k]),
+    row-sharded like the input. Each of the D ring steps moves one [n/D, d]
+    tile one hop (ppermute) and fuses an [n/D, n/D] distance tile (MXU matmul)
+    with a running top-k merge, so peak memory is O(n^2/D^2) per device.
+    """
+    n = x.shape[0]
+    n_cell = mesh.shape[CELL_AXIS]
+    n_rows = n // n_cell
+    perm = [(i, (i + 1) % n_cell) for i in range(n_cell)]
+
+    def kernel(x_local):
+        me = jax.lax.axis_index(CELL_AXIS).astype(jnp.int32)
+        my_sq = jnp.sum(x_local * x_local, axis=1)            # [n_rows]
+        row_ids = me * n_rows + jnp.arange(n_rows, dtype=jnp.int32)
+
+        def tile_topk(tile, tile_owner):
+            tile_sq = jnp.sum(tile * tile, axis=1)
+            d2 = my_sq[:, None] - 2.0 * (x_local @ tile.T) + tile_sq[None, :]
+            d2 = jnp.maximum(d2, 0.0)
+            col_ids = tile_owner * n_rows + jnp.arange(n_rows, dtype=jnp.int32)
+            d2 = jnp.where(row_ids[:, None] == col_ids[None, :], jnp.inf, d2)
+            neg, pos = jax.lax.top_k(-d2, min(k, n_rows))
+            idx = col_ids[pos]
+            if n_rows < k:  # pad so the running merge has fixed width
+                pad = k - n_rows
+                neg = jnp.concatenate([neg, jnp.full((n_rows, pad), -jnp.inf)], axis=1)
+                idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+            return -neg, idx
+
+        def step(carry, _):
+            tile, owner, best_d, best_i = carry
+            cand_d, cand_i = tile_topk(tile, owner)
+            best_d, best_i = _merge_topk(best_d, best_i, cand_d, cand_i, k)
+            tile = jax.lax.ppermute(tile, CELL_AXIS, perm)
+            owner = jax.lax.ppermute(owner, CELL_AXIS, perm)
+            return (tile, owner, best_d, best_i), None
+
+        init_d = jax.lax.pcast(jnp.full((n_rows, k), jnp.inf), (CELL_AXIS,), to="varying")
+        init_i = jax.lax.pcast(jnp.zeros((n_rows, k), jnp.int32), (CELL_AXIS,), to="varying")
+        (_, _, best_d, best_i), _ = jax.lax.scan(
+            step, (x_local, me, init_d, init_i), None, length=n_cell
+        )
+        return best_i, jnp.sqrt(best_d)
+
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=P(CELL_AXIS, None),
+        out_specs=(P(CELL_AXIS, None), P(CELL_AXIS, None)),
+    )(jnp.asarray(x, jnp.float32))

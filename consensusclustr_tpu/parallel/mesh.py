@@ -1,0 +1,57 @@
+"""Device-mesh construction for the distributed consensus pipeline.
+
+The reference's only parallel substrate is BiocParallel process pools with
+zero inter-worker traffic (reference R/consensusClust.R:391, README.md:41-45;
+SURVEY §2.4). The TPU counterpart is a 2-D ``jax.sharding.Mesh``:
+
+  * axis ``"boot"`` — data parallelism over bootstrap resamples (the analog of
+    the reference's `bplapply(1:nboots)` worker pool);
+  * axis ``"cell"`` — model parallelism over rows of the n x n co-clustering
+    matrix (the reference's OpenMP-threaded parDist pass, :421, which is the
+    memory wall at scale — SURVEY §5 long-context row).
+
+Collectives ride ICI inside a slice: one ``psum`` over "boot" accumulates the
+co-clustering counts (the design's single true all-reduce, SURVEY §2.4), and
+``ppermute`` over "cell" drives the ring kNN for sharded point sets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+BOOT_AXIS = "boot"
+CELL_AXIS = "cell"
+
+
+def factor_devices(n_devices: int) -> Tuple[int, int]:
+    """Split a device count into (boot, cell) mesh extents.
+
+    Prefers a balanced 2-D mesh (boot >= cell) so both the bootstrap fan-out
+    and the n x n matrix rows shard; falls back to all-boot for primes.
+    """
+    best = (n_devices, 1)
+    for cell in range(1, int(np.sqrt(n_devices)) + 1):
+        if n_devices % cell == 0:
+            best = (n_devices // cell, cell)
+    return best
+
+
+def consensus_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    boot: Optional[int] = None,
+    cell: Optional[int] = None,
+) -> Mesh:
+    """Build the ("boot", "cell") mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if boot is None or cell is None:
+        boot, cell = factor_devices(n)
+    if boot * cell != n:
+        raise ValueError(f"mesh {boot}x{cell} != {n} devices")
+    dev_array = np.asarray(devices).reshape(boot, cell)
+    return Mesh(dev_array, (BOOT_AXIS, CELL_AXIS))

@@ -1,0 +1,181 @@
+"""The fused distributed consensus step.
+
+This is the framework's "training step" analog: one jitted program over the
+("boot", "cell") mesh that runs the whole device-side consensus pipeline
+(reference R/consensusClust.R:388-456; SURVEY §3.1 hot loops 1-2):
+
+  bootstrap grid clustering   — data-parallel over "boot" (parallel/boots.py)
+  co-clustering counts        — MXU matmuls, psum over "boot", rows sharded
+                                over "cell" (parallel/cocluster.py)
+  consensus kNN               — local top_k per row block (parallel/knn.py)
+  SNN + Leiden res sweep      — resolution axis sharded over "boot"
+  candidate selection         — argmax over gathered scores
+
+Collectives used: one psum (co-clustering counts), the all-gather XLA inserts
+to replicate the [n, k] kNN graph, and the all-gathers implied by the sharded
+resolution sweep's outputs. Everything rides ICI inside a slice.
+
+RNG tags match the single-chip path (consensus/pipeline.py), so given the same
+inputs the distributed step selects bit-identical candidates on any mesh
+shape — the determinism contract of SURVEY §4 item 5.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from consensusclustr_tpu.cluster.engine import consensus_candidate_score
+from consensusclustr_tpu.cluster.leiden import compact_labels, leiden_fixed
+from consensusclustr_tpu.cluster.snn import snn_graph
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
+from consensusclustr_tpu.parallel.boots import sharded_run_bootstraps
+from consensusclustr_tpu.parallel.cocluster import sharded_coclustering_distance
+from consensusclustr_tpu.parallel.knn import sharded_knn_from_distance
+from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
+from consensusclustr_tpu.utils.rng import cluster_key
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "ki", "n_res", "max_clusters", "n_iters")
+)
+def _consensus_grid_sharded(
+    keys: jax.Array,       # [R] PRNG keys (global resolution order)
+    knn_idx: jax.Array,    # [n, k] int32 consensus kNN graph
+    pca: jax.Array,        # [n, d] for silhouette ranking
+    res_list: jax.Array,   # [R] resolutions (padded to a multiple of boot axis)
+    res_mask: jax.Array,   # [R] 1.0 for real entries, 0.0 for padding
+    mesh: jax.sharding.Mesh,
+    ki: int,
+    n_res: int,
+    max_clusters: int,
+    n_iters: int = 20,
+) -> Tuple[jax.Array, jax.Array]:
+    """Leiden over the resolution sweep, res axis sharded over "boot".
+
+    Returns (labels [R, n] int32, scores [R] with -inf at padding).
+    """
+    del ki, n_res  # tags live in `keys`; kept in the signature for cache keys
+
+    def kernel(keys_local, res_local, mask_local, idx_rep, pca_rep):
+        graph = snn_graph(idx_rep)
+
+        def one_res(kk, res, mask):
+            raw = leiden_fixed(kk, graph, res, n_iters=n_iters)
+            compact, n_c, overflow = compact_labels(raw, max_clusters)
+            score = consensus_candidate_score(pca_rep, compact, n_c, overflow, max_clusters)
+            return compact, jnp.where(mask > 0, score, -jnp.inf)
+
+        return jax.vmap(one_res)(keys_local, res_local, mask_local)
+
+    return jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(BOOT_AXIS), P(BOOT_AXIS), P(BOOT_AXIS), P(None, None), P(None, None)),
+        out_specs=(P(BOOT_AXIS, None), P(BOOT_AXIS)),
+    )(keys, res_list, res_mask, knn_idx, pca)
+
+
+class DistributedStepResult(NamedTuple):
+    labels: jax.Array       # [n] best consensus candidate (replicated)
+    scores: jax.Array       # [K*R_pad] candidate scores (-inf at padding)
+    dist: jax.Array         # [n, n] co-clustering distance (row-sharded)
+    boot_labels: jax.Array  # [B_pad, n] aligned boot assignments (boot-sharded)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "k_list", "max_clusters", "n_iters", "n_res_real"),
+)
+def distributed_consensus_step(
+    key: jax.Array,
+    pca: jax.Array,        # [n, d] float32
+    idx: jax.Array,        # [B_pad, m] int32 bootstrap gathers
+    res_list: jax.Array,   # [R_pad]
+    res_mask: jax.Array,   # [R_pad]
+    n_real_boots: jax.Array,  # scalar: boots beyond this are padding
+    mesh: jax.sharding.Mesh,
+    k_list: Tuple[int, ...],
+    max_clusters: int,
+    n_res_real: int,
+    n_iters: int = 20,
+) -> DistributedStepResult:
+    n, _ = pca.shape
+    b_pad = idx.shape[0]
+
+    keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(b_pad))
+    boot_labels, _ = sharded_run_bootstraps(
+        keys, idx, pca, res_list[:n_res_real], mesh, k_list,
+        max_clusters, n, n_iters=n_iters,
+    )
+    # padding boots contribute nothing to the co-clustering counts
+    boot_labels = jnp.where(
+        (jnp.arange(b_pad) < n_real_boots)[:, None], boot_labels, -1
+    )
+    dist = sharded_coclustering_distance(boot_labels, mesh, max_clusters)
+
+    all_labels, all_scores = [], []
+    r_pad = res_list.shape[0]
+    for ki, k in enumerate(k_list):
+        knn_idx, _ = sharded_knn_from_distance(dist, mesh, k)
+        # same RNG tags as the single-chip _consensus_grid (pipeline.py)
+        gkeys = jax.vmap(
+            lambda t: cluster_key(key, 90_000 + ki * 1000 + t)
+        )(jnp.arange(r_pad))
+        labels_k, scores_k = _consensus_grid_sharded(
+            gkeys, knn_idx, pca, res_list, res_mask, mesh, ki, r_pad,
+            max_clusters, n_iters,
+        )
+        all_labels.append(labels_k)
+        all_scores.append(scores_k)
+    labels = jnp.concatenate(all_labels, axis=0)
+    scores = jnp.concatenate(all_scores, axis=0)
+    best = jnp.argmax(scores)   # ties -> first, as in the single-chip path
+    return DistributedStepResult(
+        labels=labels[best], scores=scores, dist=dist, boot_labels=boot_labels
+    )
+
+
+def distributed_consensus_cluster(
+    key: jax.Array,
+    pca: np.ndarray,
+    cfg: ClusterConfig,
+    mesh: jax.sharding.Mesh,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host wrapper: pad the boot and resolution axes to the mesh, run the
+    fused step, return (labels [n], dist [n, n], boot_labels [B, n]) as numpy.
+
+    n must divide by the mesh's "cell" extent (the row-sharding granularity).
+    """
+    pca = jnp.asarray(pca, jnp.float32)
+    n = pca.shape[0]
+    db = mesh.shape[BOOT_AXIS]
+    dc = mesh.shape[CELL_AXIS]
+    if n % dc:
+        raise ValueError(f"n={n} must divide by the cell mesh axis ({dc})")
+
+    m = max(2, int(round(cfg.boot_size * n)))
+    b_pad = -(-cfg.nboots // db) * db
+    idx = bootstrap_indices(key, n, b_pad, m)
+
+    res = list(cfg.res_range)
+    r_real = len(res)
+    r_pad = -(-r_real // db) * db
+    res_arr = jnp.asarray(res + [res[-1]] * (r_pad - r_real), jnp.float32)
+    res_mask = jnp.asarray([1.0] * r_real + [0.0] * (r_pad - r_real), jnp.float32)
+
+    out = distributed_consensus_step(
+        key, pca, idx, res_arr, res_mask, jnp.int32(cfg.nboots), mesh,
+        tuple(int(k) for k in cfg.k_num), cfg.max_clusters, r_real,
+    )
+    return (
+        np.asarray(out.labels),
+        np.asarray(out.dist),
+        np.asarray(out.boot_labels[: cfg.nboots]),
+    )

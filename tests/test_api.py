@@ -113,6 +113,21 @@ class TestEndToEnd:
         # random embedding carries no signal => structure should not match truth
         assert ari(res.assignments, truth) < 0.3
 
+    def test_pca_only_input(self, nb_blobs):
+        counts, truth = nb_blobs
+        # well-separated embedding, no counts at all: the pipeline must run
+        # (null test skipped — no raw counts) and recover the structure
+        emb = np.zeros((len(truth), 6), np.float32)
+        emb[np.arange(len(truth)), truth % 6] = 10.0
+        emb += np.random.default_rng(4).normal(0, 0.5, emb.shape).astype(np.float32)
+        res = consensus_clust(pca=emb, **SMALL)
+        assert ari(res.assignments, truth) > 0.9
+
+    def test_pca_only_requires_numeric_pcnum(self):
+        emb = np.random.default_rng(5).normal(size=(50, 6)).astype(np.float32)
+        with pytest.raises(ValueError, match="counts or norm_counts"):
+            consensus_clust(pca=emb, nboots=2)  # default pc_num="find"
+
 
 class TestAdapters:
     def test_sparse_input(self, nb_blobs):
@@ -148,6 +163,61 @@ class TestAdapters:
         ing = _ingest(np.ones((3, 5), np.float32), cfg)
         assert ing.counts.shape == (3, 5)
         assert ing.covariates.shape == (3, 1)
+
+    def test_scale_data_layer_sets_flag(self, nb_blobs):
+        counts, _ = nb_blobs
+        scaled = (counts - counts.mean(0)) / (counts.std(0) + 1e-6)
+
+        class FakeAnnData:
+            X = counts
+            layers = {"counts": counts, "scale_data": scaled}
+            obs = {}
+            var = {}
+            obsm = {}
+            var_names = np.asarray([f"g{i}" for i in range(counts.shape[1])])
+            raw = None
+
+        ing = _ingest(FakeAnnData(), ClusterConfig())
+        assert ing.scale_data is True
+        assert np.allclose(ing.norm_counts, scaled)
+
+
+class TestSkipFirstRegression:
+    def _ing(self, names):
+        from consensusclustr_tpu.api import _Ingested
+
+        return _Ingested(
+            counts=None, norm_counts=None, pca=None, variable_features=None,
+            covariates=np.zeros((4, len(names) or 1), np.float32),
+            gene_names=None,
+        )
+
+    def test_subset_list_does_not_skip(self):
+        # reference :312: regression runs unless ALL varsToRegress are listed
+        from consensusclustr_tpu.api import _skip_first_regression
+
+        cfg = ClusterConfig(
+            vars_to_regress=["batch", "n_count"],
+            skip_first_regression=["batch"],
+        )
+        assert _skip_first_regression(cfg, self._ing(["batch", "n_count"])) is False
+
+    def test_full_list_skips(self):
+        from consensusclustr_tpu.api import _skip_first_regression
+
+        cfg = ClusterConfig(
+            vars_to_regress=["batch", "n_count"],
+            skip_first_regression=["batch", "n_count"],
+        )
+        assert _skip_first_regression(cfg, self._ing(["batch", "n_count"])) is True
+
+    def test_bool_passthrough(self):
+        from consensusclustr_tpu.api import _skip_first_regression
+
+        cfg = ClusterConfig(skip_first_regression=True)
+        assert _skip_first_regression(cfg, self._ing([])) is True
+        cfg = ClusterConfig(skip_first_regression=False)
+        assert _skip_first_regression(cfg, self._ing([])) is False
 
 
 class TestHelpers:

@@ -34,6 +34,8 @@ _LAZY = {
     "get_clust_assignments": ("consensusclustr_tpu.cluster.engine", "get_clust_assignments"),
     "determine_hierarchy": ("consensusclustr_tpu.hierarchy.dendro", "determine_hierarchy"),
     "test_splits": ("consensusclustr_tpu.nulltest.splits", "test_splits"),
+    "CountMatrix": ("consensusclustr_tpu.io", "CountMatrix"),
+    "load_counts": ("consensusclustr_tpu.io", "load_counts"),
 }
 
 
@@ -48,9 +50,11 @@ def __getattr__(name):
 __all__ = [
     "ClusterConfig",
     "DEFAULT_RES_RANGE",
+    "CountMatrix",
     "consensus_clust",
     "get_clust_assignments",
     "determine_hierarchy",
+    "load_counts",
     "test_splits",
     "__version__",
 ]

@@ -83,7 +83,9 @@ class _Ingested:
 
 
 def _densify(x) -> np.ndarray:
-    """Dense float32 array from dense/sparse input."""
+    """Dense float32 array from dense/sparse/CountMatrix input."""
+    if hasattr(x, "indptr") and hasattr(x, "dense"):  # io.CountMatrix
+        return x.dense()
     if hasattr(x, "toarray"):  # scipy sparse
         x = x.toarray()
     return np.asarray(x, dtype=np.float32)
@@ -203,13 +205,14 @@ def _ingest(data, cfg: ClusterConfig, norm_counts=None, pca=None) -> _Ingested:
         cov = np.asarray(cfg.vars_to_regress, dtype=np.float32)
         cov = cov.reshape(len(cov), -1)
     hvg = np.asarray(cfg.variable_features) if cfg.variable_features is not None else None
+    gene_names = getattr(data, "gene_names", None)  # io.CountMatrix carries names
     return _Ingested(
         counts=counts,
         norm_counts=_densify(norm_counts) if norm_counts is not None else None,
         pca=np.asarray(pca, np.float32) if pca is not None else None,
         variable_features=hvg,
         covariates=cov,
-        gene_names=None,
+        gene_names=gene_names,
     )
 
 
@@ -242,6 +245,8 @@ def _skip_first_regression(cfg: ClusterConfig, ing: "_Ingested") -> bool:
     skip = cfg.skip_first_regression
     if isinstance(skip, bool):
         return skip
+    if isinstance(skip, str):  # a single covariate name, not a char sequence
+        skip = [skip]
     names = (
         list(cfg.vars_to_regress)
         if isinstance(cfg.vars_to_regress, (list, tuple))

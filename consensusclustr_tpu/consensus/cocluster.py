@@ -20,12 +20,12 @@ variant; this einsum path is the portable default and the correctness oracle.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("max_clusters", "chunk"))
 def coclustering_distance(
     labels: jax.Array,
     max_clusters: int = 64,
@@ -37,7 +37,30 @@ def coclustering_distance(
     co-sampled (union 0) get distance 1 (the R kernel's 0/0 NaN would poison
     downstream kNN; the reference effectively never hits it at its default
     nboots — documented deviation).
+
+    Dispatch: on TPU with compact labels the tiled Pallas kernel
+    (ops/pallas_cocluster.py) streams raw int8 labels; elsewhere (or with
+    CCTPU_NO_PALLAS=1) the einsum path below is the oracle.
     """
+    if (
+        jax.default_backend() == "tpu"
+        and max_clusters <= 127
+        and not os.environ.get("CCTPU_NO_PALLAS")
+    ):
+        from consensusclustr_tpu.ops.pallas_cocluster import (
+            pallas_coclustering_distance,
+        )
+
+        return pallas_coclustering_distance(labels)
+    return _einsum_coclustering_distance(labels, max_clusters, chunk)
+
+
+@functools.partial(jax.jit, static_argnames=("max_clusters", "chunk"))
+def _einsum_coclustering_distance(
+    labels: jax.Array,
+    max_clusters: int = 64,
+    chunk: int = 32,
+) -> jax.Array:
     labels = jnp.asarray(labels, jnp.int32)
     b, n = labels.shape
     pad = (-b) % chunk

@@ -1,0 +1,147 @@
+"""Count-matrix container and file ingestion (SURVEY §7.2 stage 1).
+
+The reference leans on R's Matrix package (C++ dgCMatrix) for every sparse
+count matrix and on Seurat/SCE loaders for files (SURVEY §2.3 Matrix row).
+Here: a CSR container over numpy buffers filled by the native runtime
+(native/ccruntime.cpp) with pure-python fallbacks, plus format dispatch for
+the formats scRNA-seq data actually ships in — MatrixMarket (.mtx), scipy
+.npz, dense .npy, and AnnData .h5ad (gated on the optional anndata package).
+
+Orientation: cells x genes throughout (the Python convention; the reference
+is genes x cells — adapters transpose at the boundary, api.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from consensusclustr_tpu.native import coo_to_csr, read_mtx
+
+
+@dataclasses.dataclass
+class CountMatrix:
+    """CSR counts [n_cells, n_genes] with optional axis names."""
+
+    indptr: np.ndarray          # [n_cells + 1] int64
+    col: np.ndarray             # [nnz] int32 gene indices
+    val: np.ndarray             # [nnz] float32
+    shape: Tuple[int, int]
+    cell_names: Optional[np.ndarray] = None
+    gene_names: Optional[np.ndarray] = None
+
+    @property
+    def nnz(self) -> int:
+        return len(self.val)
+
+    @property
+    def density(self) -> float:
+        return self.nnz / max(self.shape[0] * self.shape[1], 1)
+
+    def dense(self) -> np.ndarray:
+        """Materialise [n_cells, n_genes] float32 (device kernels are dense)."""
+        out = np.zeros(self.shape, np.float32)
+        rows = np.repeat(
+            np.arange(self.shape[0]), np.diff(self.indptr).astype(np.int64)
+        )
+        out[rows, self.col] = self.val
+        return out
+
+    def row_sums(self) -> np.ndarray:
+        return np.add.reduceat(
+            np.append(self.val, 0.0), self.indptr[:-1].astype(np.int64)
+        ) * (np.diff(self.indptr) > 0)
+
+    @classmethod
+    def from_coo(
+        cls, row: np.ndarray, col: np.ndarray, val: np.ndarray,
+        shape: Tuple[int, int], **names,
+    ) -> "CountMatrix":
+        indptr, ccol, cval = coo_to_csr(row, col, val, shape[0])
+        return cls(indptr=indptr, col=ccol, val=cval, shape=shape, **names)
+
+    @classmethod
+    def from_dense(cls, x: np.ndarray, **names) -> "CountMatrix":
+        x = np.asarray(x)
+        row, col = np.nonzero(x)
+        return cls.from_coo(
+            row.astype(np.int32), col.astype(np.int32),
+            x[row, col].astype(np.float32), x.shape, **names,
+        )
+
+
+def load_counts(path: str, transpose: bool = False) -> CountMatrix:
+    """Load counts from .mtx / .mtx.gz / .npz / .npy / .h5ad.
+
+    `transpose=True` flips a genes x cells file (10x's mtx convention) into
+    the cells x genes orientation used throughout.
+    """
+    lower = path.lower()
+    if lower.endswith((".mtx", ".mtx.gz")):
+        if lower.endswith(".gz"):
+            import gzip
+            import shutil
+            import tempfile
+
+            with gzip.open(path, "rb") as src, tempfile.NamedTemporaryFile(
+                suffix=".mtx", delete=False
+            ) as dst:
+                shutil.copyfileobj(src, dst)
+                tmp = dst.name
+            try:
+                row, col, val, shape = read_mtx(tmp)
+            finally:
+                os.unlink(tmp)
+        else:
+            row, col, val, shape = read_mtx(path)
+        if transpose:
+            row, col, shape = col, row, (shape[1], shape[0])
+        return CountMatrix.from_coo(row, col, val, shape)
+
+    if lower.endswith(".npz"):
+        with np.load(path, allow_pickle=False) as z:
+            if "indptr" in z:  # scipy.sparse.save_npz CSR/CSC layout
+                from scipy import sparse
+
+                m = sparse.load_npz(path).tocsr()
+                if transpose:
+                    m = m.T.tocsr()
+                return CountMatrix(
+                    indptr=m.indptr.astype(np.int64),
+                    col=m.indices.astype(np.int32),
+                    val=m.data.astype(np.float32),
+                    shape=(int(m.shape[0]), int(m.shape[1])),
+                )
+            arr = z[z.files[0]]
+        return CountMatrix.from_dense(arr.T if transpose else arr)
+
+    if lower.endswith(".npy"):
+        arr = np.load(path)
+        return CountMatrix.from_dense(arr.T if transpose else arr)
+
+    if lower.endswith(".h5ad"):
+        try:
+            import anndata
+        except ImportError as e:  # pragma: no cover - optional dep
+            raise ImportError("reading .h5ad requires the anndata package") from e
+        ad = anndata.read_h5ad(path)
+        x = ad.layers.get("counts", ad.X)
+        if hasattr(x, "tocsr"):
+            m = (x.T if transpose else x).tocsr()
+            cm = CountMatrix(
+                indptr=m.indptr.astype(np.int64),
+                col=m.indices.astype(np.int32),
+                val=m.data.astype(np.float32),
+                shape=(int(m.shape[0]), int(m.shape[1])),
+            )
+        else:
+            arr = np.asarray(x)
+            cm = CountMatrix.from_dense(arr.T if transpose else arr)
+        names = (np.asarray(ad.obs_names), np.asarray(ad.var_names))
+        cm.cell_names, cm.gene_names = (names[1], names[0]) if transpose else names
+        return cm
+
+    raise ValueError(f"unsupported counts format: {path}")

@@ -1,0 +1,162 @@
+// Native host runtime for consensusclustr_tpu.
+//
+// The reference's runtime-native surface is (a) an inline Armadillo Jaccard
+// kernel applied over all cell pairs by parallelDist's OpenMP engine
+// (reference R/consensusClust.R:411-421) and (b) the C++ sparse-matrix /
+// ingestion machinery of the Matrix package that every count matrix flows
+// through. This file provides the host-side equivalents: a threaded
+// co-clustering distance (the CPU oracle / small-problem fallback for the
+// TPU kernels) and a MatrixMarket COO parser feeding the CSR ingestion path
+// (SURVEY §7.2 stage 1).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Co-clustering (consensus Jaccard) distance, threaded over row blocks.
+//
+// labels: [B, n] row-major int32, -1 = cell unsampled in that bootstrap.
+// out:    [n, n] row-major float32 distance; diagonal 0; never-co-sampled
+//         pairs get 1 (same contract as the device kernels).
+// ---------------------------------------------------------------------------
+void cc_jaccard_distance(const int32_t* labels, int64_t n_boots, int64_t n_cells,
+                         float* out, int n_threads) {
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  std::atomic<int64_t> next_row{0};
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next_row.fetch_add(1);
+      if (i >= n_cells) return;
+      out[i * n_cells + i] = 0.0f;
+      for (int64_t j = i + 1; j < n_cells; ++j) {
+        int64_t agree = 0, both = 0;
+        for (int64_t b = 0; b < n_boots; ++b) {
+          const int32_t li = labels[b * n_cells + i];
+          const int32_t lj = labels[b * n_cells + j];
+          const bool valid = (li >= 0) & (lj >= 0);
+          both += valid;
+          agree += valid & (li == lj);
+        }
+        const float d = both > 0 ? 1.0f - (float)agree / (float)both : 1.0f;
+        out[i * n_cells + j] = d;
+        out[j * n_cells + i] = d;
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// MatrixMarket coordinate-format parser -> COO buffers.
+//
+// Two-phase protocol for ctypes: cc_mtx_open parses the file into an opaque
+// handle and reports (rows, cols, nnz); cc_mtx_fill copies the triplets into
+// caller-allocated arrays; cc_mtx_close frees the handle. Supports the
+// "%%MatrixMarket matrix coordinate (real|integer|pattern) general|symmetric"
+// headers 10x/scanpy exports use.
+// ---------------------------------------------------------------------------
+struct CcMtx {
+  int64_t rows = 0, cols = 0;
+  std::vector<int32_t> r, c;
+  std::vector<float> v;
+};
+
+void* cc_mtx_open(const char* path, int64_t* rows, int64_t* cols, int64_t* nnz) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  char line[1 << 16];
+  bool symmetric = false, pattern = false;
+  // header line
+  if (!std::fgets(line, sizeof line, f)) { std::fclose(f); return nullptr; }
+  if (std::strncmp(line, "%%MatrixMarket", 14) != 0 ||
+      !std::strstr(line, "coordinate")) {
+    std::fclose(f);
+    return nullptr;
+  }
+  symmetric = std::strstr(line, "symmetric") != nullptr;
+  pattern = std::strstr(line, "pattern") != nullptr;
+  // comments, then the size line
+  int64_t nr = 0, nc = 0, nz = 0;
+  for (;;) {
+    if (!std::fgets(line, sizeof line, f)) { std::fclose(f); return nullptr; }
+    if (line[0] == '%') continue;
+    if (std::sscanf(line, "%ld %ld %ld", &nr, &nc, &nz) != 3) {
+      std::fclose(f);
+      return nullptr;
+    }
+    break;
+  }
+  auto* m = new CcMtx;
+  m->rows = nr;
+  m->cols = nc;
+  m->r.reserve(nz);
+  m->c.reserve(nz);
+  m->v.reserve(nz);
+  while (std::fgets(line, sizeof line, f)) {
+    const char* p = line;
+    while (*p && std::isspace((unsigned char)*p)) ++p;
+    if (!*p || *p == '%') continue;
+    char* end = nullptr;
+    const long ri = std::strtol(p, &end, 10);
+    const long ci = std::strtol(end, &end, 10);
+    double val = 1.0;
+    if (!pattern) val = std::strtod(end, &end);
+    m->r.push_back((int32_t)(ri - 1));  // MatrixMarket is 1-based
+    m->c.push_back((int32_t)(ci - 1));
+    m->v.push_back((float)val);
+    if (symmetric && ri != ci) {
+      m->r.push_back((int32_t)(ci - 1));
+      m->c.push_back((int32_t)(ri - 1));
+      m->v.push_back((float)val);
+    }
+  }
+  std::fclose(f);
+  *rows = m->rows;
+  *cols = m->cols;
+  *nnz = (int64_t)m->r.size();
+  return m;
+}
+
+void cc_mtx_fill(void* handle, int32_t* row_idx, int32_t* col_idx, float* values) {
+  auto* m = (CcMtx*)handle;
+  std::memcpy(row_idx, m->r.data(), m->r.size() * sizeof(int32_t));
+  std::memcpy(col_idx, m->c.data(), m->c.size() * sizeof(int32_t));
+  std::memcpy(values, m->v.data(), m->v.size() * sizeof(float));
+}
+
+void cc_mtx_close(void* handle) { delete (CcMtx*)handle; }
+
+// ---------------------------------------------------------------------------
+// COO -> CSR conversion (counting sort), threaded value scatter.
+// indptr: [rows+1], out_col/out_val: [nnz] caller-allocated.
+// ---------------------------------------------------------------------------
+void cc_coo_to_csr(const int32_t* row_idx, const int32_t* col_idx,
+                   const float* values, int64_t nnz, int64_t rows,
+                   int64_t* indptr, int32_t* out_col, float* out_val) {
+  std::memset(indptr, 0, (rows + 1) * sizeof(int64_t));
+  for (int64_t k = 0; k < nnz; ++k) indptr[row_idx[k] + 1]++;
+  for (int64_t r = 0; r < rows; ++r) indptr[r + 1] += indptr[r];
+  std::vector<int64_t> cursor(indptr, indptr + rows);
+  for (int64_t k = 0; k < nnz; ++k) {
+    const int64_t dst = cursor[row_idx[k]]++;
+    out_col[dst] = col_idx[k];
+    out_val[dst] = values[k];
+  }
+}
+
+}  // extern "C"

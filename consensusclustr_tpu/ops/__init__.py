@@ -1,0 +1,10 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+Every kernel here has a portable XLA twin that serves as its correctness
+oracle (SURVEY §2.2); dispatch happens at the call sites based on backend and
+the use_pallas config flag.
+"""
+
+from consensusclustr_tpu.ops.pallas_cocluster import pallas_coclustering_distance
+
+__all__ = ["pallas_coclustering_distance"]

@@ -3,9 +3,12 @@
 Distributed form of consensus/pipeline.py's ``run_bootstraps`` — the TPU
 counterpart of the reference's `bplapply(1:nboots)` worker pool
 (reference R/consensusClust.R:388-400; SURVEY §2.4 row 1): bootstraps are
-data-parallel over the mesh's "boot" axis; the PCA matrix is replicated (it is
-small — n x pcNum); each device runs the full kNN->SNN->Leiden grid for its
-local bootstraps via the same jitted kernels as the single-chip path.
+data-parallel over the FLATTENED ("boot", "cell") mesh — every device in the
+2-D mesh owns a distinct slice of the boot axis, so no compute is duplicated
+across the cell axis; the PCA matrix is replicated (it is small — n x pcNum);
+each device runs the full kNN->SNN->Leiden grid for its local bootstraps via
+the same jitted kernels as the single-chip path. The co-clustering stage then
+reshards the labels to boot-axis-only layout (one all-gather over "cell").
 
 Like the reference's share-nothing workers, no communication happens here —
 the assignments stay boot-sharded and flow straight into the sharded
@@ -29,7 +32,7 @@ from consensusclustr_tpu.cluster.engine import (
     cluster_grid,
     ties_last_argmax,
 )
-from consensusclustr_tpu.parallel.mesh import BOOT_AXIS
+from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 
 
 @functools.partial(
@@ -50,11 +53,13 @@ def sharded_run_bootstraps(
     """Robust-mode bootstraps over the mesh.
 
     Returns (labels [B, n] int32 with -1 for unsampled, scores [B]), sharded
-    over the "boot" mesh axis. B must divide by the boot axis extent.
+    over the flattened ("boot", "cell") mesh axes. B must divide by the total
+    device count.
     """
-    if idx.shape[0] % mesh.shape[BOOT_AXIS]:
+    n_dev = mesh.shape[BOOT_AXIS] * mesh.shape[CELL_AXIS]
+    if idx.shape[0] % n_dev:
         raise ValueError(
-            f"B={idx.shape[0]} not divisible by boot axis {mesh.shape[BOOT_AXIS]}"
+            f"B={idx.shape[0]} not divisible by device count {n_dev}"
         )
 
     def kernel(keys_local, idx_local, pca_rep, res_rep):
@@ -70,9 +75,10 @@ def sharded_run_bootstraps(
 
         return jax.vmap(one)(keys_local, idx_local)
 
+    both = (BOOT_AXIS, CELL_AXIS)
     return jax.shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(BOOT_AXIS), P(BOOT_AXIS, None), P(None, None), P(None)),
-        out_specs=(P(BOOT_AXIS, None), P(BOOT_AXIS)),
+        in_specs=(P(both), P(both, None), P(None, None), P(None)),
+        out_specs=(P(both, None), P(both)),
     )(keys, idx, jnp.asarray(pca, jnp.float32), jnp.asarray(res_list, jnp.float32))

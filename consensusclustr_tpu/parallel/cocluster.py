@@ -13,10 +13,12 @@ reference's OpenMP parDist pass over the inline Armadillo kernel
     the MXU, then one ``psum`` over "boot" completes the counts — the single
     true all-reduce in the whole design.
 
-At 1M cells (BASELINE.json config 5) the full float32 matrix is 4 TB; the
-row-sharded blocks at cell=8 are 500 GB/device-row — still too big to hold,
-which is why the distributed step (parallel/step.py) immediately reduces each
-row block to its top-k neighbours and never keeps the dense block.
+At 1M cells (BASELINE.json config 5) the full float32 matrix is 4 TB; even
+row-sharded it cannot be held dense, so at that scale the consensus graph
+must be built from the top-k of each row block as it is produced (blockwise
+kNN + sparse graph — the dist output here is for the moderate-n regime where
+the row-sharded matrix fits, and the step wrapper's `return_dist=False` skips
+the host gather).
 """
 
 from __future__ import annotations

@@ -23,7 +23,7 @@ shape — the determinism contract of SURVEY §4 item 5.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +57,8 @@ def _consensus_grid_sharded(
     max_clusters: int,
     n_iters: int = 20,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Leiden over the resolution sweep, res axis sharded over "boot".
+    """Leiden over the resolution sweep, res axis sharded over the flattened
+    ("boot", "cell") mesh — every device owns distinct resolutions.
 
     Returns (labels [R, n] int32, scores [R] with -inf at padding).
     """
@@ -74,11 +75,12 @@ def _consensus_grid_sharded(
 
         return jax.vmap(one_res)(keys_local, res_local, mask_local)
 
+    both = (BOOT_AXIS, CELL_AXIS)
     return jax.shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(BOOT_AXIS), P(BOOT_AXIS), P(BOOT_AXIS), P(None, None), P(None, None)),
-        out_specs=(P(BOOT_AXIS, None), P(BOOT_AXIS)),
+        in_specs=(P(both), P(both), P(both), P(None, None), P(None, None)),
+        out_specs=(P(both, None), P(both)),
     )(keys, res_list, res_mask, knn_idx, pca)
 
 
@@ -147,26 +149,31 @@ def distributed_consensus_cluster(
     pca: np.ndarray,
     cfg: ClusterConfig,
     mesh: jax.sharding.Mesh,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    return_dist: bool = True,
+) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
     """Host wrapper: pad the boot and resolution axes to the mesh, run the
-    fused step, return (labels [n], dist [n, n], boot_labels [B, n]) as numpy.
+    fused step, return (labels [n], dist [n, n] or None, boot_labels [B, n])
+    as numpy.
 
     n must divide by the mesh's "cell" extent (the row-sharding granularity).
+    `return_dist=False` skips the host gather of the dense distance matrix —
+    required at the scales where the matrix only exists row-sharded (the
+    downstream merges then run on the boot labels / kNN graph instead).
     """
     pca = jnp.asarray(pca, jnp.float32)
     n = pca.shape[0]
-    db = mesh.shape[BOOT_AXIS]
     dc = mesh.shape[CELL_AXIS]
+    n_dev = mesh.shape[BOOT_AXIS] * dc
     if n % dc:
         raise ValueError(f"n={n} must divide by the cell mesh axis ({dc})")
 
     m = max(2, int(round(cfg.boot_size * n)))
-    b_pad = -(-cfg.nboots // db) * db
+    b_pad = -(-cfg.nboots // n_dev) * n_dev
     idx = bootstrap_indices(key, n, b_pad, m)
 
     res = list(cfg.res_range)
     r_real = len(res)
-    r_pad = -(-r_real // db) * db
+    r_pad = -(-r_real // n_dev) * n_dev
     res_arr = jnp.asarray(res + [res[-1]] * (r_pad - r_real), jnp.float32)
     res_mask = jnp.asarray([1.0] * r_real + [0.0] * (r_pad - r_real), jnp.float32)
 
@@ -176,6 +183,6 @@ def distributed_consensus_cluster(
     )
     return (
         np.asarray(out.labels),
-        np.asarray(out.dist),
+        np.asarray(out.dist) if return_dist else None,
         np.asarray(out.boot_labels[: cfg.nboots]),
     )

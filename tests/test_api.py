@@ -219,6 +219,18 @@ class TestSkipFirstRegression:
         cfg = ClusterConfig(skip_first_regression=False)
         assert _skip_first_regression(cfg, self._ing([])) is False
 
+    def test_bare_string_is_one_name(self):
+        from consensusclustr_tpu.api import _skip_first_regression
+
+        cfg = ClusterConfig(
+            vars_to_regress=["batch"], skip_first_regression="batch"
+        )
+        assert _skip_first_regression(cfg, self._ing(["batch"])) is True
+        cfg = ClusterConfig(
+            vars_to_regress=["batch", "n_count"], skip_first_regression="batch"
+        )
+        assert _skip_first_regression(cfg, self._ing(["batch", "n_count"])) is False
+
 
 class TestHelpers:
     def test_relabel_first_seen(self):

@@ -1,0 +1,121 @@
+"""Native runtime (ccruntime.cpp) + ingestion tests.
+
+The native Jaccard kernel is the host oracle for the device co-clustering
+kernels, so all three implementations are cross-checked here.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from consensusclustr_tpu.consensus.cocluster import _einsum_coclustering_distance
+from consensusclustr_tpu.io import CountMatrix, load_counts
+from consensusclustr_tpu.native import (
+    coo_to_csr,
+    jaccard_distance_host,
+    load_library,
+    read_mtx,
+)
+
+
+def test_native_library_builds():
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain; numpy fallbacks in use")
+    assert load_library() is not None, "g++ build of ccruntime.so failed"
+
+
+def test_host_jaccard_matches_device_oracle():
+    r = np.random.default_rng(0)
+    labels = r.integers(-1, 5, size=(12, 70)).astype(np.int32)
+    host = jaccard_distance_host(labels)
+    dev = np.asarray(_einsum_coclustering_distance(jnp.asarray(labels), 8))
+    np.testing.assert_allclose(host, dev, atol=1e-6)
+
+
+def test_host_jaccard_single_thread_deterministic():
+    r = np.random.default_rng(1)
+    labels = r.integers(-1, 3, size=(6, 40)).astype(np.int32)
+    a = jaccard_distance_host(labels, n_threads=1)
+    b = jaccard_distance_host(labels, n_threads=4)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mtx_roundtrip(tmp_path):
+    r = np.random.default_rng(2)
+    dense = (r.random((15, 9)) < 0.3) * r.integers(1, 9, (15, 9))
+    path = tmp_path / "m.mtx"
+    rows, cols = np.nonzero(dense)
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate integer general\n")
+        f.write("% a comment line\n")
+        f.write(f"{dense.shape[0]} {dense.shape[1]} {len(rows)}\n")
+        for i, j in zip(rows, cols):
+            f.write(f"{i+1} {j+1} {dense[i,j]}\n")
+
+    ri, ci, v, shape = read_mtx(str(path))
+    assert shape == dense.shape
+    rebuilt = np.zeros(dense.shape, np.float32)
+    rebuilt[ri, ci] = v
+    np.testing.assert_array_equal(rebuilt, dense.astype(np.float32))
+
+    cm = load_counts(str(path))
+    np.testing.assert_array_equal(cm.dense(), dense.astype(np.float32))
+    # 10x orientation: genes x cells -> transpose
+    cm_t = load_counts(str(path), transpose=True)
+    np.testing.assert_array_equal(cm_t.dense(), dense.T.astype(np.float32))
+
+
+def test_mtx_pattern_and_symmetric(tmp_path):
+    path = tmp_path / "s.mtx"
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate pattern symmetric\n")
+        f.write("3 3 2\n")
+        f.write("2 1\n3 3\n")
+    ri, ci, v, shape = read_mtx(str(path))
+    rebuilt = np.zeros(shape, np.float32)
+    rebuilt[ri, ci] = v
+    want = np.zeros((3, 3), np.float32)
+    want[1, 0] = want[0, 1] = want[2, 2] = 1.0
+    np.testing.assert_array_equal(rebuilt, want)
+
+
+def test_coo_to_csr_matches_scipy():
+    sp = pytest.importorskip("scipy.sparse")
+    r = np.random.default_rng(3)
+    n, g, nnz = 20, 11, 60
+    row = r.integers(0, n, nnz).astype(np.int32)
+    col = r.integers(0, g, nnz).astype(np.int32)
+    val = r.random(nnz).astype(np.float32)
+    indptr, ccol, cval = coo_to_csr(row, col, val, n)
+    ours = sp.csr_matrix((cval, ccol, indptr), shape=(n, g)).toarray()
+    want = sp.coo_matrix((val, (row, col)), shape=(n, g)).toarray()
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+def test_count_matrix_dense_roundtrip():
+    r = np.random.default_rng(4)
+    dense = (r.random((12, 7)) < 0.4) * r.integers(1, 5, (12, 7)).astype(np.float32)
+    cm = CountMatrix.from_dense(dense)
+    np.testing.assert_array_equal(cm.dense(), dense)
+    assert cm.nnz == int((dense != 0).sum())
+
+
+def test_count_matrix_feeds_consensus_clust():
+    from consensusclustr_tpu.api import _densify
+
+    r = np.random.default_rng(6)
+    dense = r.poisson(2.0, size=(8, 5)).astype(np.float32)
+    cm = CountMatrix.from_dense(dense)
+    np.testing.assert_array_equal(_densify(cm), dense)
+
+
+def test_load_npz_sparse(tmp_path):
+    sp = pytest.importorskip("scipy.sparse")
+    r = np.random.default_rng(5)
+    dense = (r.random((10, 6)) < 0.5) * r.integers(1, 4, (10, 6))
+    path = tmp_path / "c.npz"
+    sp.save_npz(path, sp.csr_matrix(dense.astype(np.float32)))
+    cm = load_counts(str(path))
+    np.testing.assert_array_equal(cm.dense(), dense.astype(np.float32))

@@ -97,6 +97,7 @@ class ClusterConfig:
     compute_dtype: str = "float32"
     use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
     progress: bool = False      # structured per-level logging
+    checkpoint_dir: Optional[str] = None  # persist boot chunks; resume on rerun
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):

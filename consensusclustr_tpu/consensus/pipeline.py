@@ -114,6 +114,11 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     Returns (boot_labels [B_eff, n] int32 with -1 for unsampled, scores).
     In granular mode B_eff = nboots * |k_num| * |res_range| (reference keeps
     every candidate, :688).
+
+    With cfg.checkpoint_dir set, each completed chunk is persisted and a rerun
+    with identical (pca, config, seed) resumes at the first missing chunk
+    (SURVEY §5 checkpoint row; robust mode only — granular chunks depend on
+    the candidate grid shape and are cheap to recompute per candidate).
     """
     n, _ = pca.shape
     m = max(2, int(round(cfg.boot_size * n)))
@@ -125,10 +130,36 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list)
     )
 
+    ckpt = None
+    if cfg.checkpoint_dir and robust:
+        from consensusclustr_tpu.utils.checkpoint import (
+            BootCheckpoint,
+            run_fingerprint,
+        )
+
+        fp = run_fingerprint(
+            np.asarray(pca),
+            {
+                "nboots": cfg.nboots, "boot_size": cfg.boot_size,
+                "k_num": list(k_list), "res_range": list(cfg.res_range),
+                "max_clusters": cfg.max_clusters, "chunk": chunk,
+            },
+            np.asarray(jax.random.key_data(key)).tobytes(),
+        )
+        ckpt = BootCheckpoint(cfg.checkpoint_dir, fp, cfg.nboots, n)
+
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
     out_labels, out_scores = [], []
     for s in range(0, cfg.nboots, chunk):
         e = min(s + chunk, cfg.nboots)
+        if ckpt is not None:
+            cached = ckpt.load_chunk(s, e - s)
+            if cached is not None:
+                out_labels.append(cached[0])
+                out_scores.append(cached[1])
+                if log:
+                    log.event("boots_resumed", done=e, total=cfg.nboots)
+                continue
         # min_size=0: the reference never passes its minSize into the boot
         # grids (:394-395 vs :650's minSize=0 default) — the 0.15 floor is
         # inert here and only bites in the null sims (minSize=5).
@@ -139,6 +170,8 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         )
         out_labels.append(np.asarray(labels))
         out_scores.append(np.asarray(scores))
+        if ckpt is not None:
+            ckpt.save_chunk(s, out_labels[-1], out_scores[-1])
         if log:
             log.event("boots", done=e, total=cfg.nboots)
     labels = np.concatenate(out_labels, axis=0)

@@ -1,0 +1,82 @@
+"""Multi-host (multi-slice) initialisation — the DCN leg of the backend.
+
+The reference's distribution story tops out at single-machine BiocParallel
+pools (SURVEY §2.4); the scale configs (BASELINE.json config 5) need a
+multi-host TPU pod. JAX's runtime handles the cross-host plumbing once
+jax.distributed is initialised; after that, `consensus_mesh` over
+jax.devices() spans the whole pod and the existing shard_map programs run
+unchanged — psum over "boot" rides ICI within a slice and DCN across slices,
+exactly the layering SURVEY §5's distributed-backend row prescribes.
+
+Call `ensure_distributed()` once per process before building meshes. It is a
+no-op on a single host (and under the CPU test mesh), keying off the standard
+cluster env vars (JAX_COORDINATOR_ADDRESS / TPU metadata autodetection).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def ensure_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise jax.distributed when a multi-host environment is detected
+    (or when explicitly configured). Returns True if distributed mode is on.
+
+    Detection: explicit args > JAX_COORDINATOR_ADDRESS env > TPU pod metadata
+    (jax.distributed.initialize() autodetects on Cloud TPU). Safe to call
+    multiple times.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    explicit = coordinator_address is not None
+    autodetect = os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
+    if not explicit and not autodetect:
+        return False  # single host: nothing to do
+    if _already_initialized():
+        _initialized = True
+        return True
+    if explicit:
+        # num_processes/process_id may come from env (jax reads
+        # JAX_NUM_PROCESSES / JAX_PROCESS_ID) when not passed
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    else:
+        jax.distributed.initialize()
+    _initialized = True
+    return True
+
+
+def _already_initialized() -> bool:
+    """True iff jax.distributed was initialised by an outer launcher."""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
+def process_info() -> dict:
+    """Topology summary for logs: process index/count, local/global devices."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -1,0 +1,62 @@
+"""Tracing / profiling hooks (SURVEY §5 tracing row).
+
+The reference has no timers at all; the tracked metric here is bootstraps/sec
+(BASELINE.md), so the two tools that matter are wall-clock phase timers that
+land in the structured LevelLog and jax.profiler traces for kernel-level work
+(viewable in TensorBoard / Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from consensusclustr_tpu.utils.log import LevelLog
+
+
+class PhaseSink:
+    """Set ``.value`` to the phase's result so the timer blocks on it."""
+
+    value = None
+
+
+@contextlib.contextmanager
+def phase(name: str, log: Optional[LevelLog] = None, **fields) -> Iterator[PhaseSink]:
+    """Wall-clock a pipeline phase into the structured log.
+
+    JAX dispatch is async, so a timer that exits before the device finishes
+    records dispatch time, not compute. Assign the phase's output arrays to
+    the yielded sink and the timer blocks on them at exit:
+
+        with phase("boots", log) as p:
+            p.value = jitted_fn(x)
+
+    Without a sink value, only host work inside the block is covered.
+    """
+    sink = PhaseSink()
+    t0 = time.perf_counter()
+    try:
+        yield sink
+    finally:
+        if sink.value is not None:
+            jax.block_until_ready(sink.value)
+        if log is not None:
+            log.event("phase", name=name, seconds=round(time.perf_counter() - t0, 4), **fields)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace of everything inside the block (TensorBoard format)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a device trace (shows up in the profiler timeline)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,102 @@
+"""Optional matplotlib renderings of the pipeline's outputs.
+
+The reference renders two plots: an interactive PCA elbow during pcNum
+selection (reference R/consensusClust.R:342-346) and a clustree of the
+iterated hierarchy (:603-606); it also returns a stats dendrogram the user
+typically plot()s. Equivalents here, all gated on matplotlib so the core
+package stays plot-free (SURVEY §2.3 ggplot2/clustree rows).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _mpl():
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        return plt
+    except ImportError as e:  # pragma: no cover - matplotlib is baked in
+        raise ImportError("plotting requires matplotlib") from e
+
+
+def plot_elbow(sdev: np.ndarray, chosen: Optional[int] = None, path: Optional[str] = None):
+    """Scree/elbow plot of PC standard deviations (reference :342-346).
+
+    Returns the matplotlib Figure; saves to `path` when given.
+    """
+    plt = _mpl()
+    sdev = np.asarray(sdev)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(np.arange(1, len(sdev) + 1), sdev, marker="o", ms=3, lw=1)
+    if chosen is not None:
+        ax.axvline(chosen, color="tab:red", ls="--", lw=1, label=f"pcNum = {chosen}")
+        ax.legend()
+    ax.set_xlabel("principal component")
+    ax.set_ylabel("standard deviation")
+    ax.set_title("PCA elbow")
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
+
+
+def plot_clustree(
+    table: Dict[str, np.ndarray],
+    edges: List[Tuple[str, str, int]],
+    path: Optional[str] = None,
+):
+    """Layered lineage-tree rendering of the clustree table/edges
+    (hierarchy/clustree.py) — node size ~ cell count, edge width ~ flow.
+    """
+    plt = _mpl()
+    cols = sorted(table, key=lambda c: int(c.removeprefix("Cluster")))
+    # node positions: depth on y, nodes spread on x in label order
+    pos: Dict[Tuple[int, str], Tuple[float, float]] = {}
+    sizes: Dict[Tuple[int, str], int] = {}
+    for d, col in enumerate(cols):
+        labels, counts = np.unique(np.asarray(table[col], dtype=str), return_counts=True)
+        for i, (lab, cnt) in enumerate(zip(labels, counts)):
+            pos[(d, lab)] = (i - (len(labels) - 1) / 2.0, -d)
+            sizes[(d, lab)] = int(cnt)
+    fig, ax = plt.subplots(figsize=(7, 1.8 + 1.2 * len(cols)))
+    max_flow = max((n for *_ , n in edges), default=1)
+    for parent, child, n in edges:
+        pd = parent.count("_")
+        cd = child.count("_")
+        if (pd, parent) in pos and (cd, child) in pos:
+            (x0, y0), (x1, y1) = pos[(pd, parent)], pos[(cd, child)]
+            ax.plot([x0, x1], [y0, y1], color="grey", lw=0.5 + 2.5 * n / max_flow, zorder=1)
+    max_size = max(sizes.values(), default=1)
+    for (d, lab), (x, y) in pos.items():
+        ax.scatter([x], [y], s=100 + 900 * sizes[(d, lab)] / max_size, zorder=2)
+        ax.annotate(lab, (x, y), ha="center", va="center", fontsize=8, zorder=3)
+    ax.set_yticks([-d for d in range(len(cols))], cols)
+    ax.set_xticks([])
+    for side in ("top", "right", "bottom", "left"):
+        ax.spines[side].set_visible(False)
+    ax.set_title("cluster hierarchy")
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig
+
+
+def plot_dendrogram(dend, path: Optional[str] = None):
+    """Render a hierarchy.dendro.Dendrogram (merge-matrix format)."""
+    plt = _mpl()
+    from scipy.cluster.hierarchy import dendrogram as scipy_dendrogram
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    scipy_dendrogram(dend.linkage, labels=list(dend.labels), ax=ax)
+    ax.set_ylabel("distance")
+    fig.tight_layout()
+    if path:
+        fig.savefig(path, dpi=120)
+    return fig

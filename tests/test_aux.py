@@ -1,0 +1,111 @@
+"""Aux subsystems: checkpoint/resume, profiling, multi-host init (SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+from consensusclustr_tpu.parallel.multihost import ensure_distributed, process_info
+from consensusclustr_tpu.utils.checkpoint import BootCheckpoint, run_fingerprint
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.profiling import phase
+from consensusclustr_tpu.utils.rng import root_key
+
+from conftest import make_blobs
+
+
+class TestCheckpoint:
+    def test_fingerprint_sensitivity(self):
+        pca = np.ones((4, 2), np.float32)
+        a = run_fingerprint(pca, {"nboots": 4}, b"k1")
+        assert a == run_fingerprint(pca.copy(), {"nboots": 4}, b"k1")
+        assert a != run_fingerprint(pca, {"nboots": 5}, b"k1")
+        # the PRNG key data, not the config seed, keys the cache
+        assert a != run_fingerprint(pca, {"nboots": 4}, b"k2")
+        assert a != run_fingerprint(pca + 1, {"nboots": 4}, b"k1")
+
+    def test_different_key_does_not_resume_stale_chunks(self, tmp_path):
+        x, _ = make_blobs(n_per=16, n_genes=6, n_clusters=2, seed=10)
+        pca = x[:, :3].astype(np.float32)
+        cfg = ClusterConfig(
+            nboots=4, k_num=(5,), res_range=(0.2,), max_clusters=16,
+            boot_batch=2, checkpoint_dir=str(tmp_path),
+        )
+        a, _ = run_bootstraps(root_key(1), pca, cfg)
+        b, _ = run_bootstraps(root_key(2), pca, cfg)
+        want_b, _ = run_bootstraps(root_key(2), pca, cfg.replace(checkpoint_dir=None))
+        np.testing.assert_array_equal(b, want_b)
+        assert not np.array_equal(a, b)
+
+    def test_chunk_roundtrip(self, tmp_path):
+        ck = BootCheckpoint(str(tmp_path), "abc", nboots=8, n_cells=5)
+        labels = np.arange(10, dtype=np.int32).reshape(2, 5)
+        scores = np.asarray([0.1, 0.2])
+        ck.save_chunk(0, labels, scores)
+        got = ck.load_chunk(0, 2)
+        np.testing.assert_array_equal(got[0], labels)
+        np.testing.assert_allclose(got[1], scores)
+        assert ck.load_chunk(2, 2) is None
+        assert ck.completed_boots() == 2
+
+    def test_fingerprints_do_not_collide(self, tmp_path):
+        # iterate=True reuses one checkpoint root for every subproblem;
+        # per-fingerprint subdirectories must never touch each other
+        ck = BootCheckpoint(str(tmp_path), "abc", nboots=8, n_cells=5)
+        ck.save_chunk(0, np.zeros((2, 5), np.int32), np.zeros(2))
+        ck2 = BootCheckpoint(str(tmp_path), "DIFFERENT", nboots=8, n_cells=5)
+        assert ck2.load_chunk(0, 2) is None
+        assert ck.load_chunk(0, 2) is not None  # untouched by ck2
+
+    def test_torn_temp_cleaned_and_not_counted(self, tmp_path):
+        ck = BootCheckpoint(str(tmp_path), "abc", nboots=8, n_cells=5)
+        ck.save_chunk(0, np.zeros((2, 5), np.int32), np.zeros(2))
+        # simulate a crash between savez and replace
+        torn = f"{ck.dir}/boots_000002.npz.tmp.npz"
+        np.savez(torn, labels=np.zeros((2, 5), np.int32), scores=np.zeros(2))
+        assert ck.completed_boots() == 2  # temp not double-counted
+        ck3 = BootCheckpoint(str(tmp_path), "abc", nboots=8, n_cells=5)
+        import os
+
+        assert not os.path.exists(torn)  # reopened store cleans torn writes
+
+    def test_resume_produces_identical_labels(self, tmp_path):
+        x, _ = make_blobs(n_per=24, n_genes=8, n_clusters=2, seed=9)
+        pca = x[:, :4].astype(np.float32)
+        cfg = ClusterConfig(
+            nboots=6, k_num=(5,), res_range=(0.1, 0.5), max_clusters=16,
+            boot_batch=2, checkpoint_dir=str(tmp_path),
+        )
+        key = root_key(5)
+        want, want_s = run_bootstraps(key, pca, cfg.replace(checkpoint_dir=None))
+        first, _ = run_bootstraps(key, pca, cfg)
+        np.testing.assert_array_equal(first, want)
+        # second run resumes entirely from disk
+        log = LevelLog()
+        again, again_s = run_bootstraps(key, pca, cfg, log=log)
+        np.testing.assert_array_equal(again, want)
+        np.testing.assert_allclose(again_s, want_s, atol=1e-6)
+        kinds = {r["kind"] for r in log.records}
+        assert "boots_resumed" in kinds and "boots" not in kinds
+
+
+class TestProfiling:
+    def test_phase_records_time(self):
+        log = LevelLog()
+        with phase("demo", log, n=3):
+            pass
+        assert log.records[-1]["kind"] == "phase"
+        assert log.records[-1]["name"] == "demo"
+        assert log.records[-1]["seconds"] >= 0
+
+
+class TestMultihost:
+    def test_single_host_noop(self, monkeypatch):
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        assert ensure_distributed() is False
+
+    def test_process_info_shape(self):
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_devices"] == 8

@@ -1,0 +1,40 @@
+"""Smoke tests for the optional plotting layer."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+from consensusclustr_tpu.hierarchy.clustree import hierarchy_edges, hierarchy_table
+from consensusclustr_tpu.hierarchy.dendro import determine_hierarchy
+from consensusclustr_tpu.viz import plot_clustree, plot_dendrogram, plot_elbow
+
+
+def test_plot_elbow(tmp_path):
+    sdev = np.exp(-np.arange(30) / 5.0)
+    fig = plot_elbow(sdev, chosen=7, path=str(tmp_path / "elbow.png"))
+    assert (tmp_path / "elbow.png").exists()
+    assert fig.axes[0].get_title() == "PCA elbow"
+
+
+def test_plot_clustree(tmp_path):
+    labels = np.asarray(
+        ["1", "1", "2_1", "2_1", "2_2", "2_2", "2_2"], dtype=object
+    )
+    table = hierarchy_table(labels)
+    edges = hierarchy_edges(labels)
+    plot_clustree(table, edges, path=str(tmp_path / "tree.png"))
+    assert (tmp_path / "tree.png").exists()
+
+
+def test_plot_dendrogram(tmp_path):
+    r = np.random.default_rng(0)
+    x = r.normal(size=(30, 3))
+    x[10:20] += 5
+    x[20:] += 10
+    d = np.linalg.norm(x[:, None] - x[None, :], axis=2)
+    labels = np.repeat(["1", "2", "3"], 10)
+    dend = determine_hierarchy(d, labels)
+    plot_dendrogram(dend, path=str(tmp_path / "dend.png"))
+    assert (tmp_path / "dend.png").exists()

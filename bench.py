@@ -31,6 +31,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from consensusclustr_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     from consensusclustr_tpu.config import ClusterConfig
     from consensusclustr_tpu.consensus.cocluster import coclustering_distance
     from consensusclustr_tpu.consensus.pipeline import run_bootstraps

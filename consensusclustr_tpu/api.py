@@ -260,6 +260,40 @@ def _skip_first_regression(cfg: ClusterConfig, ing: "_Ingested") -> bool:
     return len(list(skip)) > 0 and all(v in list(skip) for v in names)
 
 
+def _interactive_pc_num(norm, cfg: ClusterConfig, key, input_fn=input) -> Optional[int]:
+    """Interactive pcNum selection (reference :342-346): render the elbow,
+    prompt for a PC count; empty/invalid answer falls back to the elbow rule.
+
+    Headless processes (no tty) skip the prompt entirely. The elbow is saved
+    to ./pca_elbow.png (the reference shows a ggplot; a saved file works for
+    remote TPU sessions).
+    """
+    import sys
+
+    from consensusclustr_tpu.linalg.pca import truncated_pca
+
+    if not sys.stdin.isatty() and input_fn is input:
+        return None
+    k50 = min(50, min(norm.shape))
+    res = truncated_pca(
+        jnp.asarray(norm, jnp.float32), k50, center=cfg.center, scale=cfg.scale,
+        key=cluster_key(key, "elbow"),
+    )
+    try:
+        from consensusclustr_tpu.viz import plot_elbow
+
+        plot_elbow(np.asarray(res.sdev), path="pca_elbow.png")
+        where = " (elbow saved to pca_elbow.png)"
+    except Exception:
+        where = ""
+    answer = input_fn(f"Number of PCs to use{where} [enter = auto]: ").strip()
+    try:
+        chosen = int(answer)
+    except ValueError:
+        return None
+    return chosen if 0 < chosen <= k50 else None
+
+
 def _valid_k(k_num: Sequence[int], n: int) -> Tuple[int, ...]:
     """Drop neighbourhood sizes that exceed the cell count (the reference's
     tryCatch would absorb the resulting igraph error into a single-cluster
@@ -353,6 +387,17 @@ def _level(
         log.event("regressed", method=cfg.regress_method)
 
     # --- PCA + pcNum (:321-382) -------------------------------------------
+    if (
+        cfg.interactive
+        and depth == 1
+        and cfg.pc_num == "find"
+        and norm is not None
+        and not use_given_pca
+    ):
+        chosen = _interactive_pc_num(norm, cfg, key)
+        if chosen is not None:
+            cfg = cfg.replace(pc_num=chosen)
+            log.event("interactive_pc_num", pc_num=chosen)
     if use_given_pca:
         pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
         pca = np.asarray(ing.pca[:, :pc_num], np.float32)
@@ -479,6 +524,9 @@ def consensus_clust(
     Returns ClusterResult(assignments, cluster_dendrogram, clustree) per the
     reference's result contract (SURVEY §8.3).
     """
+    from consensusclustr_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     cfg = (config or ClusterConfig()).replace(**params) if params else (config or ClusterConfig())
     log = LevelLog(enabled=cfg.progress)
     key = root_key(cfg.seed)

@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache.
+
+The pipeline's jitted programs are keyed by (shape, static args); a fresh
+process otherwise pays the full TPU compile (~20-40 s per program) again.
+Pointing jax's compilation cache at a disk directory makes every rerun—and
+every recursion level that repeats a shape—hit the cache across processes.
+
+Enabled by the top-level API on first use; opt out with CCTPU_NO_COMPILE_CACHE
+or redirect with CCTPU_COMPILE_CACHE_DIR.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_done = False
+
+
+def enable_persistent_cache() -> None:
+    global _done
+    if _done or os.environ.get("CCTPU_NO_COMPILE_CACHE"):
+        return
+    cache_dir = os.environ.get(
+        "CCTPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "consensusclustr_tpu", "xla"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: recursion levels re-enter many small jits
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimisation, never a requirement
+    _done = True

@@ -1,6 +1,6 @@
 """Benchmark harness: bootstraps/sec through the consensus inner loop.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The tracked metric is BASELINE.md's bootstraps/sec: full bootstrap grid
 clusterings (kNN -> SNN -> Leiden over the (k, resolution) grid + silhouette
@@ -11,6 +11,13 @@ The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
 against the driver's north star rate: 1000 bootstraps x 12 resolutions on 50k
 cells in <60 s => 16.67 boots/sec (BASELINE.json:5). vs_baseline > 1 beats it.
 
+Hardening contract (VERDICT r2 weak #2): this script never exits non-zero and
+always emits the JSON line. Failure ladder:
+  1. Pallas kernel failure -> einsum fallback (inside coclustering_distance).
+  2. Accelerator backend init/compile failure -> re-exec once on CPU
+     (JAX_PLATFORMS=cpu) with smoke-sized shapes.
+  3. Anything else -> JSON line with value 0.0 and the error message.
+
 Env knobs: BENCH_CELLS, BENCH_BOOTS, BENCH_RES, BENCH_PCS (defaults scale with
 the backend: accelerator vs CPU smoke).
 """
@@ -19,15 +26,22 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+import traceback
 
 import numpy as np
 
 
 NORTH_STAR_BOOTS_PER_SEC = 1000.0 / 60.0
+_RETRY_FLAG = "CCTPU_BENCH_CPU_RETRY"
 
 
-def main() -> None:
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _run() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -35,12 +49,15 @@ def main() -> None:
 
     enable_persistent_cache()
 
+    from consensusclustr_tpu import consensus as _  # noqa: F401  (import check)
     from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.consensus import cocluster as cocluster_mod
     from consensusclustr_tpu.consensus.cocluster import coclustering_distance
     from consensusclustr_tpu.consensus.pipeline import run_bootstraps
     from consensusclustr_tpu.utils.rng import root_key
 
-    on_accel = jax.default_backend() not in ("cpu",)
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
     n = int(os.environ.get("BENCH_CELLS", 10_000 if on_accel else 512))
     nboots = int(os.environ.get("BENCH_BOOTS", 24 if on_accel else 8))
     n_res = int(os.environ.get("BENCH_RES", 12))
@@ -61,7 +78,10 @@ def main() -> None:
 
     def run():
         labels, _ = run_bootstraps(key, pca_dev, cfg)
-        dist = coclustering_distance(jnp.asarray(labels, jnp.int32), cfg.max_clusters)
+        dist = coclustering_distance(
+            jnp.asarray(labels, jnp.int32), cfg.max_clusters,
+            use_pallas=cfg.use_pallas,
+        )
         return jax.block_until_ready(dist)
 
     run()  # warmup: compiles the exact chunk shapes the timed run uses
@@ -71,15 +91,54 @@ def main() -> None:
     dt = time.perf_counter() - t0
     boots_per_sec = nboots / dt
 
-    print(
-        json.dumps(
-            {
-                "metric": f"bootstraps/sec ({n} cells, {n_res} res, k=3, to consensus matrix)",
-                "value": round(boots_per_sec, 3),
-                "unit": "boots/s",
-                "vs_baseline": round(boots_per_sec / NORTH_STAR_BOOTS_PER_SEC, 3),
-            }
+    return {
+        "metric": f"bootstraps/sec ({n} cells, {n_res} res, k=3, to consensus matrix)",
+        "value": round(boots_per_sec, 3),
+        "unit": "boots/s",
+        "vs_baseline": round(boots_per_sec / NORTH_STAR_BOOTS_PER_SEC, 3),
+        "backend": backend,
+        "path": cocluster_mod.LAST_PATH,
+        "cells": n,
+        "boots": nboots,
+        "wall_s": round(dt, 3),
+    }
+
+
+def main() -> None:
+    try:
+        _emit(_run())
+        return
+    except Exception:
+        err = traceback.format_exc(limit=3)
+        sys.stderr.write(err)
+
+    # Accelerator path died (backend init, compile, OOM). Retry once on CPU
+    # with smoke shapes so the round still records a number.
+    if not os.environ.get(_RETRY_FLAG) and os.environ.get("JAX_PLATFORMS") != "cpu":
+        sys.stderr.write("bench: retrying on CPU backend\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **{_RETRY_FLAG: "1"})
+        for k in list(env):
+            if k.startswith("BENCH_"):  # smoke shapes, not the accel workload
+                del env[k]
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, text=True,
         )
+        out = proc.stdout.strip().splitlines()
+        if out:
+            print(out[-1], flush=True)
+            return
+
+    _emit(
+        {
+            "metric": "bootstraps/sec (failed run)",
+            "value": 0.0,
+            "unit": "boots/s",
+            "vs_baseline": 0.0,
+            "error": err.strip().splitlines()[-1][:300],
+        }
     )
 
 

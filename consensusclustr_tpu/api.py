@@ -439,6 +439,7 @@ def _level(
                 key=cluster_key(key, "nulltest"),
                 test_separately=cfg.test_splits_separately,
                 max_clusters=cfg.max_clusters, log=log,
+                cluster_fun=cfg.cluster_fun,
             )
             labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))

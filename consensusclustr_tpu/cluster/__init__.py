@@ -1,9 +1,14 @@
 from consensusclustr_tpu.cluster.knn import knn_points, knn_from_distance
 from consensusclustr_tpu.cluster.snn import snn_graph
-from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
+from consensusclustr_tpu.cluster.leiden import (
+    compact_labels,
+    leiden_fixed,
+    louvain_fixed,
+)
 from consensusclustr_tpu.cluster.metrics import approx_silhouette, mean_silhouette_score, pairwise_rand
 from consensusclustr_tpu.cluster.engine import (
     cluster_grid,
+    community_detect,
     get_clust_assignments,
     candidate_score,
     consensus_candidate_score,

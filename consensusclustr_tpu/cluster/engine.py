@@ -25,7 +25,11 @@ import numpy as np
 from consensusclustr_tpu.config import DEFAULT_RES_RANGE
 from consensusclustr_tpu.cluster.knn import knn_points
 from consensusclustr_tpu.cluster.snn import snn_graph
-from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
+from consensusclustr_tpu.cluster.leiden import (
+    compact_labels,
+    leiden_fixed,
+    louvain_fixed,
+)
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.utils.rng import cluster_key, root_key
 
@@ -93,9 +97,25 @@ def consensus_candidate_score(
     return jnp.where(informative, sil, jnp.where(all_singleton, -1.0, 0.15))
 
 
+def community_detect(
+    kk: jax.Array,
+    graph,
+    res: jax.Array,
+    cluster_fun: str = "leiden",
+    n_iters: int = 20,
+    update_frac: float = 0.5,
+) -> jax.Array:
+    """Dispatch to the selected community-detection kernel. The reference
+    switches igraph::cluster_leiden vs cluster_louvain through bluster's
+    SNNGraphParam(cluster.fun=...) (R/consensusClust.R:656)."""
+    if cluster_fun == "louvain":
+        return louvain_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
+    return leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac"),
+    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun"),
 )
 def cluster_grid(
     key: jax.Array,
@@ -106,12 +126,13 @@ def cluster_grid(
     max_clusters: int = 64,
     n_iters: int = 20,
     update_frac: float = 0.5,
+    cluster_fun: str = "leiden",
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set.
 
     The kNN/SNN graph is built once per k (it does not depend on resolution);
-    Leiden is vmapped over the resolution axis — the reference instead runs
-    6000 sequential igraph calls per level (SURVEY §3.1 hot loop #1).
+    Leiden/Louvain is vmapped over the resolution axis — the reference instead
+    runs 6000 sequential igraph calls per level (SURVEY §3.1 hot loop #1).
     """
     x = jnp.asarray(x, jnp.float32)
     res_list = jnp.asarray(res_list, jnp.float32)
@@ -124,7 +145,9 @@ def cluster_grid(
         keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
 
         def one_res(kk, res):
-            raw = leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
+            raw = community_detect(
+                kk, graph, res, cluster_fun, n_iters=n_iters, update_frac=update_frac
+            )
             compact, n_c, overflow = compact_labels(raw, max_clusters)
             score = candidate_score(x, compact, n_c, overflow, min_size, max_clusters)
             return compact, n_c, score
@@ -191,10 +214,10 @@ def get_clust_assignments(
     min_size defaults to 0 as in the reference (:650), where the 0.15 floor is
     inert for the main pipeline and only the null sims pass minSize=5.
 
-    `cluster_fun` selects leiden/louvain; both map to the fixed-iteration
-    masked local-move kernel (docs/quirks.md D2/item 6).
+    `cluster_fun` selects leiden (fixed-iteration masked local moves + merge
+    phase) or louvain (multi-level aggregation with dense coarse-graph moves)
+    — two genuinely distinct kernels, as in the reference (:656).
     """
-    del cluster_fun  # one kernel serves both (quirks item 6 / D2)
     if key is None:
         key = root_key(seed)
     x = jnp.asarray(pca, jnp.float32)
@@ -206,6 +229,7 @@ def get_clust_assignments(
         jnp.asarray(min_size, jnp.float32),
         max_clusters=max_clusters,
         n_iters=n_iters,
+        cluster_fun=cluster_fun,
     )
     if mode == "robust":
         # ties.method="last": argmax on the reversed array

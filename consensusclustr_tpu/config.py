@@ -98,6 +98,12 @@ class ClusterConfig:
     use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
     progress: bool = False      # structured per-level logging
     checkpoint_dir: Optional[str] = None  # persist boot chunks; resume on rerun
+    # Distributed execution: None = single chip; "auto" = shard over all
+    # visible devices when >1; or an explicit jax.sharding.Mesh built by
+    # parallel.mesh.consensus_mesh. The pipeline falls back to single-chip
+    # (with a log event) when a level's shape can't shard (granular mode,
+    # nboots<=1, or n not divisible by the mesh's cell axis).
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -121,6 +127,10 @@ class ClusterConfig:
             raise ValueError("pc_var must be in (0, 1]")
         if self.nboots < 0 or self.min_size < 0 or self.n_var_features <= 0:
             raise ValueError("nboots/min_size must be >= 0, n_var_features > 0")
+        if self.mesh is not None and not (
+            self.mesh == "auto" or hasattr(self.mesh, "devices")
+        ):
+            raise ValueError("mesh must be None, 'auto', or a jax.sharding.Mesh")
 
     def replace(self, **kw) -> "ClusterConfig":
         return dataclasses.replace(self, **kw)

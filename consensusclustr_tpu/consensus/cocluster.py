@@ -21,15 +21,34 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# Which implementation the last coclustering_distance call used:
+# "pallas" | "einsum". Read by bench.py to report the measured path.
+LAST_PATH: str = "einsum"
+
+
+def _pallas_wanted(use_pallas: Optional[bool], max_clusters: int) -> bool:
+    """Resolve the dispatch: the CCTPU_NO_PALLAS env kill-switch beats the
+    config flag beats the backend default — the env var must win even over an
+    explicit use_pallas=True so a broken kernel can be disabled fleet-wide
+    without touching configs. The kernel needs int8-compact labels."""
+    if max_clusters > 127 or jax.default_backend() != "tpu":
+        return False
+    if os.environ.get("CCTPU_NO_PALLAS"):
+        return False
+    return True if use_pallas is None else bool(use_pallas)
 
 
 def coclustering_distance(
     labels: jax.Array,
     max_clusters: int = 64,
     chunk: int = 32,
+    use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """labels: [B, n] int32, -1 == not sampled in that column.
 
@@ -39,19 +58,30 @@ def coclustering_distance(
     nboots — documented deviation).
 
     Dispatch: on TPU with compact labels the tiled Pallas kernel
-    (ops/pallas_cocluster.py) streams raw int8 labels; elsewhere (or with
-    CCTPU_NO_PALLAS=1) the einsum path below is the oracle.
+    (ops/pallas_cocluster.py) streams raw int8 labels; elsewhere the einsum
+    path below is the oracle. ``use_pallas`` (ClusterConfig.use_pallas) forces
+    the choice; None = auto; CCTPU_NO_PALLAS=1 disables globally. A Pallas
+    compile/runtime failure falls back to the einsum path with a warning —
+    the pipeline never dies on a kernel regression.
     """
-    if (
-        jax.default_backend() == "tpu"
-        and max_clusters <= 127
-        and not os.environ.get("CCTPU_NO_PALLAS")
-    ):
+    global LAST_PATH
+    if _pallas_wanted(use_pallas, max_clusters):
         from consensusclustr_tpu.ops.pallas_cocluster import (
             pallas_coclustering_distance,
         )
 
-        return pallas_coclustering_distance(labels)
+        try:
+            out = pallas_coclustering_distance(labels)
+            LAST_PATH = "pallas"
+            return out
+        except Exception as e:  # Mosaic compile or OOM: degrade, don't die
+            warnings.warn(
+                f"Pallas co-clustering kernel failed ({type(e).__name__}: {e}); "
+                "falling back to the einsum path",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    LAST_PATH = "einsum"
     return _einsum_coclustering_distance(labels, max_clusters, chunk)
 
 

@@ -32,10 +32,11 @@ from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.cluster.engine import (
     align_to_cells,
     cluster_grid,
+    community_detect,
     ties_last_argmax as _ties_last_argmax,
 )
 from consensusclustr_tpu.cluster.knn import knn_from_distance
-from consensusclustr_tpu.cluster.leiden import leiden_fixed, compact_labels
+from consensusclustr_tpu.cluster.leiden import compact_labels
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.cluster.engine import consensus_candidate_score
 from consensusclustr_tpu.cluster.snn import snn_graph
@@ -59,7 +60,10 @@ class ConsensusResult(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells"),
+    static_argnames=(
+        "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
+        "cluster_fun",
+    ),
 )
 def _boot_batch(
     keys: jax.Array,          # [chunk]
@@ -73,6 +77,7 @@ def _boot_batch(
     n_iters: int,
     robust: bool,
     n_cells: int,
+    cluster_fun: str = "leiden",
 ):
     """One jitted chunk of bootstraps: gather -> grid -> select -> align."""
 
@@ -80,7 +85,7 @@ def _boot_batch(
         x = pca[idx_b]
         grid = cluster_grid(
             key_b, x, res_list, k_list, min_size,
-            max_clusters=max_clusters, n_iters=n_iters,
+            max_clusters=max_clusters, n_iters=n_iters, cluster_fun=cluster_fun,
         )
         if robust:
             best = _ties_last_argmax(grid.scores)
@@ -98,13 +103,18 @@ def _auto_boot_chunk(
 ) -> int:
     if requested > 0:
         return max(1, min(requested, nboots))
-    # Bound the per-chunk workspace: the kNN m x m distance pass plus the
-    # Leiden local-move gain tensor [n_res, m, e, e+2] float32 (e = 2k edge
-    # slots). The TPU runtime hard-crashes (not OOMs gracefully) when pushed,
-    # so track ~2 GB of workspace per chunk against the 16 GB HBM.
+    # Bound the per-chunk workspace: the blockwise kNN row tile plus the
+    # Leiden local-move working set — ~8 [m, e] f32 arrays per resolution
+    # (sort/cumsum/gather buffers), vmapped over n_res (e = 2k edge slots).
+    # The TPU runtime hard-crashes (not OOMs gracefully) when pushed, so
+    # track a conservative budget against the 16 GB HBM.
+    from consensusclustr_tpu.cluster.knn import KNN_BLOCK
+
     e = 2 * k_max
-    per_boot = m * m * 4.0 + n_res * m * e * (e + 2) * 4.0
-    budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9))
+    knn_bytes = (m * m if m <= 2 * KNN_BLOCK else KNN_BLOCK * m) * 4.0
+    per_boot = knn_bytes + n_res * m * e * 4.0 * 8.0
+    default_budget = 2e9 if jax.default_backend() == "cpu" else 6e9
+    budget = float(os.environ.get("CCTPU_CHUNK_BYTES", default_budget))
     return int(max(1, min(nboots, budget // max(per_boot, 1.0), 64)))
 
 
@@ -167,6 +177,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
             keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
             jnp.float32(0.0),
             len(cfg.res_range), cfg.max_clusters, 20, robust, n,
+            cfg.cluster_fun,
         )
         out_labels.append(np.asarray(labels))
         out_scores.append(np.asarray(scores))
@@ -183,7 +194,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k_list", "max_clusters", "n_iters")
+    jax.jit, static_argnames=("k_list", "max_clusters", "n_iters", "cluster_fun")
 )
 def _consensus_grid(
     key: jax.Array,
@@ -193,6 +204,7 @@ def _consensus_grid(
     k_list,
     max_clusters: int,
     n_iters: int = 20,
+    cluster_fun: str = "leiden",
 ):
     """Consensus re-clustering (reference :423-441): kNN on the distance
     matrix per k, SNN, Leiden per resolution; rank by PCA silhouette with the
@@ -205,7 +217,7 @@ def _consensus_grid(
         keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r))
 
         def one_res(kk, res):
-            raw = leiden_fixed(kk, graph, res, n_iters=n_iters)
+            raw = community_detect(kk, graph, res, cluster_fun, n_iters=n_iters)
             compact, n_c, overflow = compact_labels(raw, max_clusters)
             score = consensus_candidate_score(pca, compact, n_c, overflow, max_clusters)
             return compact, score
@@ -222,21 +234,120 @@ def _consensus_grid(
     return labels[best], scores
 
 
+def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
+    """Resolve cfg.mesh to a usable Mesh or None (single-chip).
+
+    Falls back (with a log event) when the level cannot shard: granular mode,
+    nboots<=1, a 1-device mesh, or n not divisible by the cell axis.
+    """
+    m = cfg.mesh
+    if m is None:
+        return None
+    auto = False
+    if isinstance(m, str):
+        if m != "auto":
+            raise ValueError(f"mesh must be None, 'auto' or a Mesh; got {m!r}")
+        if len(jax.devices()) <= 1:
+            return None
+        from consensusclustr_tpu.parallel.mesh import consensus_mesh
+
+        auto = True
+        m = consensus_mesh()
+    reason = None
+    if cfg.nboots <= 1:
+        reason = "nboots<=1"
+    elif cfg.mode != "robust":
+        reason = "granular mode"
+    else:
+        from consensusclustr_tpu.parallel.mesh import CELL_AXIS, consensus_mesh
+
+        if n % m.shape[CELL_AXIS]:
+            if auto:
+                # a boot-only mesh always satisfies divisibility; keep the
+                # bootstrap fan-out sharded rather than idling every device
+                m = consensus_mesh(boot=len(jax.devices()), cell=1)
+                if log:
+                    log.event("mesh_auto_boot_only", n=n)
+            else:
+                reason = (
+                    f"n={n} not divisible by cell axis {m.shape[CELL_AXIS]}"
+                )
+    if reason is not None:
+        if log:
+            log.event("mesh_fallback", reason=reason)
+        return None
+    return m
+
+
+def _finish_consensus(
+    pca: jax.Array,
+    labels: np.ndarray,
+    dist_np: np.ndarray,
+    boot_labels: np.ndarray,
+    cfg: ClusterConfig,
+    k_list,
+    log: Optional[LevelLog],
+) -> ConsensusResult:
+    """Shared tail of the bootstrap paths: small-cluster merge (:461-467),
+    stability merge (:469-497), final silhouette."""
+    # small-cluster merge on co-clustering distances (:461-467)
+    labels = merge_small_clusters(
+        dist_np, labels, max(k_list[0], 20), cfg.max_clusters
+    )
+    # stability merge against the per-boot assignments (:469-497)
+    labels = merge_unstable_clusters(
+        labels, boot_labels, cfg.min_stability, cfg.max_clusters
+    )
+    sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
+    if log:
+        log.event(
+            "merged", n_clusters=len(np.unique(labels)), silhouette=sil,
+        )
+    return ConsensusResult(
+        labels=labels,
+        silhouette=sil,
+        jaccard_dist=dist_np,
+        boot_labels=boot_labels,
+        n_clusters=len(np.unique(labels)),
+    )
+
+
 def consensus_cluster(
     key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None
 ) -> ConsensusResult:
-    """Full L5: reference :388-511."""
+    """Full L5: reference :388-511. With cfg.mesh set, the bootstrap fan-out,
+    co-clustering distance and consensus grid run sharded over the device mesh
+    (parallel/step.py); the merge/stability tail is identical either way."""
     pca = jnp.asarray(pca, jnp.float32)
     n = pca.shape[0]
     res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
     k_list = tuple(int(k) for k in cfg.k_num)
+
+    mesh = _resolve_mesh(cfg, n, log)
+    if mesh is not None:
+        from consensusclustr_tpu.parallel.step import (
+            distributed_consensus_cluster,
+        )
+
+        labels_np, dist_np, boot_labels = distributed_consensus_cluster(
+            key, pca, cfg, mesh
+        )
+        if log:
+            log.event(
+                "consensus_distributed",
+                n_clusters=len(np.unique(labels_np)),
+                mesh={k: v for k, v in mesh.shape.items()},
+            )
+        return _finish_consensus(
+            pca, labels_np, dist_np, boot_labels, cfg, k_list, log
+        )
 
     if cfg.nboots <= 1:
         # no-bootstrap path (reference :498-511); min_size=0 as in the boot
         # path — the reference's :500 call leaves minSize at its 0 default
         grid = cluster_grid(
             key, pca, res_list, k_list, jnp.float32(0.0),
-            max_clusters=cfg.max_clusters,
+            max_clusters=cfg.max_clusters, cluster_fun=cfg.cluster_fun,
         )
         best = int(_ties_last_argmax(grid.scores))
         labels = np.asarray(grid.labels[best])
@@ -258,10 +369,12 @@ def consensus_cluster(
 
     boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log)
     dist = coclustering_distance(
-        jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters
+        jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
+        use_pallas=cfg.use_pallas,
     )
     cons_labels, cons_scores = _consensus_grid(
-        key, dist, pca, res_list, k_list, cfg.max_clusters
+        key, dist, pca, res_list, k_list, cfg.max_clusters,
+        cluster_fun=cfg.cluster_fun,
     )
     labels = np.asarray(cons_labels)
     dist_np = np.asarray(dist)
@@ -270,24 +383,4 @@ def consensus_cluster(
             "consensus", n_clusters=len(np.unique(labels)),
             best_score=float(np.max(np.asarray(cons_scores))),
         )
-
-    # small-cluster merge on co-clustering distances (:461-467)
-    labels = merge_small_clusters(
-        dist_np, labels, max(k_list[0], 20), cfg.max_clusters
-    )
-    # stability merge against the per-boot assignments (:469-497)
-    labels = merge_unstable_clusters(
-        labels, boot_labels, cfg.min_stability, cfg.max_clusters
-    )
-    sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
-    if log:
-        log.event(
-            "merged", n_clusters=len(np.unique(labels)), silhouette=sil,
-        )
-    return ConsensusResult(
-        labels=labels,
-        silhouette=sil,
-        jaccard_dist=dist_np,
-        boot_labels=boot_labels,
-        n_clusters=len(np.unique(labels)),
-    )
+    return _finish_consensus(pca, labels, dist_np, boot_labels, cfg, k_list, log)

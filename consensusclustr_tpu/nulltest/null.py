@@ -43,7 +43,10 @@ from consensusclustr_tpu.utils.rng import sim_key
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_cells", "pc_num", "k_list", "pool_sizes", "max_clusters", "has_cov"),
+    static_argnames=(
+        "n_cells", "pc_num", "k_list", "pool_sizes", "max_clusters", "has_cov",
+        "cluster_fun",
+    ),
 )
 def _null_stat_batch(
     keys: jax.Array,                 # [chunk, 2] split per sim
@@ -56,6 +59,7 @@ def _null_stat_batch(
     pool_sizes: Tuple[int, ...],
     max_clusters: int,
     has_cov: bool,
+    cluster_fun: str = "leiden",
 ) -> jax.Array:
     def one(key):
         k_sim, k_pca, k_clu = jax.random.split(key, 3)
@@ -73,6 +77,7 @@ def _null_stat_batch(
         grid = cluster_grid(
             k_clu, pca, res_list, k_list,
             jnp.float32(NULL_SIM_MIN_SIZE), max_clusters=max_clusters,
+            cluster_fun=cluster_fun,
         )
         best = _ties_last_argmax(grid.scores)
         labels = grid.labels[best]
@@ -95,13 +100,21 @@ def generate_null_statistics(
     max_clusters: int = 64,
     round_id: int = 0,
     chunk: int = 4,
+    cluster_fun: str = "leiden",
+    res_range=None,
 ) -> np.ndarray:
     """n_sims null silhouettes, chunk-vmapped on device.
 
     `round_id` keys the adaptive rounds (the reference bumps RNGseed+1 for the
     extra 20-sim rounds, :944/:956 — here it folds into the PRNG tree).
+
+    `res_range=None` keeps the reference's hardcoded null sweep
+    (R/consensusClust.R:803); a sequence overrides it (the knob testSplits'
+    shadowed resRange argument was presumably meant to be, :892).
     """
-    res_list = jnp.asarray(NULL_SIM_RES_RANGE, jnp.float32)
+    res_list = jnp.asarray(
+        NULL_SIM_RES_RANGE if res_range is None else list(res_range), jnp.float32
+    )
     k_list = tuple(int(k) for k in k_num)
     pool_sizes = default_pool_sizes(n_cells)
     has_cov = covariates is not None
@@ -119,7 +132,7 @@ def generate_null_statistics(
                 _null_stat_batch(
                     keys[s:e], model, cov, res_list,
                     int(n_cells), int(pc_num), k_list, pool_sizes,
-                    int(max_clusters), has_cov,
+                    int(max_clusters), has_cov, cluster_fun,
                 )
             )
         )

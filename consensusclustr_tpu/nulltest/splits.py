@@ -67,6 +67,8 @@ def _clustering_rejected(
     n_sims: int,
     max_clusters: int,
     log: Optional[LevelLog],
+    cluster_fun: str = "leiden",
+    res_range=None,
 ) -> tuple:
     """One full adaptive null test.
 
@@ -80,6 +82,7 @@ def _clustering_rejected(
     stats = generate_null_statistics(
         key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
         covariates=covariates, max_clusters=max_clusters, round_id=0,
+        cluster_fun=cluster_fun, res_range=res_range,
     )
     p = null_p_value(silhouette, stats)
     # Adaptive refinement near the boundary (reference :943-964): +20 sims if
@@ -90,6 +93,7 @@ def _clustering_rejected(
             generate_null_statistics(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
                 covariates=covariates, max_clusters=max_clusters, round_id=1,
+                cluster_fun=cluster_fun, res_range=res_range,
             ),
         ])
         p = null_p_value(silhouette, stats)
@@ -99,6 +103,7 @@ def _clustering_rejected(
             generate_null_statistics(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
                 covariates=covariates, max_clusters=max_clusters, round_id=2,
+                cluster_fun=cluster_fun, res_range=res_range,
             ),
         ])
         p = null_p_value(silhouette, stats)
@@ -128,8 +133,17 @@ def test_splits(
     test_separately: bool = False,
     max_clusters: int = 64,
     log: Optional[LevelLog] = None,
+    cluster_fun: str = "leiden",
+    res_range=None,
 ) -> np.ndarray:
     """Public API mirroring the reference export (NAMESPACE:6; :891).
+
+    `cluster_fun` flows into the null-sim clusterings, as the reference's
+    clusterFun does via testSplits' `...` (:536-537 -> :935 -> :803).
+    `res_range` mirrors the reference signature's resRange (:892); there it is
+    shadowed by generateNullStatistic's hardcoded sweep, so None (default)
+    reproduces reference behavior and a sequence actually overrides the
+    null-sim sweep (documented intent-fix, docs/quirks.md).
 
     counts: [n_cells, n_hvg] raw counts (the reference builds an SCE of HVG
     counts, :526-531). pca: [n_cells, d]. assignments: per-cell labels.
@@ -158,6 +172,7 @@ def test_splits(
             key, counts, sil, pc_num,
             alpha=alpha, k_num=k_num, covariates=covariates,
             n_sims=n_sims, max_clusters=max_clusters, log=log,
+            cluster_fun=cluster_fun, res_range=res_range,
         )
         if rejected:
             return np.full(n, "1", dtype=object)
@@ -168,6 +183,7 @@ def test_splits(
         pc_num=pc_num, k_num=k_num, alpha=alpha,
         silhouette_thresh=silhouette_thresh, covariates=covariates,
         n_sims=n_sims, max_clusters=max_clusters, log=log, depth=0,
+        cluster_fun=cluster_fun, res_range=res_range,
     )
 
 
@@ -203,6 +219,8 @@ def _test_tree(
     max_clusters: int,
     log: Optional[LevelLog],
     depth: int,
+    cluster_fun: str = "leiden",
+    res_range=None,
 ) -> np.ndarray:
     """Per-split walk (reference :894-905, 966-1036): test this subtree's top
     split; on failure, softly merge the majority cluster of each branch and
@@ -224,6 +242,7 @@ def _test_tree(
             cluster_key(key, f"split_{depth}"), counts, sil, pc_num,
             alpha=alpha, k_num=k_num, covariates=covariates,
             n_sims=n_sims, max_clusters=max_clusters, log=log,
+            cluster_fun=cluster_fun, res_range=res_range,
         )
         # Failed split: merge the majority cluster of each branch into one
         # cluster, rebuild the dendrogram from Euclidean PCA distances, and
@@ -271,5 +290,6 @@ def _test_tree(
             pc_num=pc_num, k_num=k_num, alpha=alpha,
             silhouette_thresh=silhouette_thresh, covariates=cov_sub,
             n_sims=n_sims, max_clusters=max_clusters, log=log, depth=depth + 1,
+            cluster_fun=cluster_fun, res_range=res_range,
         )
     return labels

@@ -7,11 +7,20 @@ Armadillo kernel + parDist/OpenMP pass (reference R/consensusClust.R:411-421):
 
 The XLA einsum path one-hot encodes labels to ride the MXU, which round-trips
 a [chunk, n, max_clusters] bf16 tensor through HBM per scan step. This kernel
-instead tiles the n x n output over a (i, j) grid and streams the raw int8
-label matrix: each program holds two [B, T] label tiles in VMEM (~0.5 MB at
-B=1024, T=256) and accumulates agreement/valid counts with VPU compares over
-boot chunks — no one-hot ever exists, and each output tile is written exactly
-once, fused with the final 1 - agree/union division.
+instead tiles the n x n output over an (i, j, boot-block) grid and streams the
+raw int8 label matrix: each program step holds two [BOOT_BLOCK, TILE] label
+tiles in VMEM (~128 KB each at BOOT_BLOCK=512, TILE=256) and accumulates
+agreement/valid counts in int32 VMEM scratch with VPU compares. The boot axis
+is the innermost grid dimension, so arbitrarily large B (granular mode:
+nboots x |k| x |res|) streams through fixed VMEM instead of residing whole —
+no one-hot ever exists, and each output tile is written exactly once, fused
+with the final 1 - agree/union division.
+
+Mosaic constraint honored here: minor-dim insertion (`x[:, :, None]`) is only
+supported for 32-bit types, so labels are widened to int32 *before* any
+broadcast reshape and all mask algebra is int32 arithmetic — no i1/i8 vector
+ever gets a new minor dimension (this exact pattern failed to compile in
+round 2: `tpu.reshape vector<8x256xi1> -> vector<8x256x1xi1>`).
 
 Numerical contract matches coclustering_distance exactly: never-co-sampled
 pairs get distance 1, diagonal forced to 0.
@@ -27,40 +36,59 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE = 256          # output tile edge; multiple of the (32, 128) int8 tile
-BOOT_CHUNK = 8      # boots per VPU accumulation step
+BOOT_BLOCK = 512    # boots streamed per grid step (int8 tile: 128 KB in VMEM)
+BOOT_CHUNK = 8      # boots per VPU accumulation step inside a block
 
 
-def _cocluster_kernel(li_ref, lj_ref, out_ref):
-    """li_ref/lj_ref: [B_pad, TILE] int8 label tiles; out_ref: [TILE, TILE] f32."""
-    b_pad = li_ref.shape[0]
+def _cocluster_kernel(li_ref, lj_ref, out_ref, agree_ref, union_ref):
+    """li_ref/lj_ref: [boot_block, TILE] int8 label tiles (one boot block);
+    out_ref: [TILE, TILE] f32; agree/union: int32 VMEM scratch accumulators
+    that persist across the boot grid dimension (innermost, so the (i, j)
+    output block is fixed while boot blocks stream)."""
+    boot_block = li_ref.shape[0]
+    # grid queries hoisted out of the pl.when closures: program_id inside a
+    # when-body fails to lower in interpret mode (cond-wrapped primitive)
+    nb = pl.num_programs(2)
+    b = pl.program_id(2)
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _init():
+        agree_ref[:] = jnp.zeros((TILE, TILE), jnp.int32)
+        union_ref[:] = jnp.zeros((TILE, TILE), jnp.int32)
 
     def body(c, carry):
         agree, union = carry
-        li = li_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :]     # [C, T] int8
-        lj = lj_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :]
-        vi = (li >= 0)[:, :, None]                            # [C, T, 1]
-        vj = (lj >= 0)[:, None, :]                            # [C, 1, T]
-        both = vi & vj                                        # [C, T, T]
-        eq = (li[:, :, None] == lj[:, None, :]) & both
-        agree = agree + jnp.sum(eq.astype(jnp.int32), axis=0)
-        union = union + jnp.sum(both.astype(jnp.int32), axis=0)
+        li = li_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :].astype(jnp.int32)
+        lj = lj_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :].astype(jnp.int32)
+        # int32 throughout: valid masks as 0/1 ints, equality applied via
+        # where() — no boolean vector is ever reshaped (Mosaic i1 limit).
+        vi = (li >= 0).astype(jnp.int32)                      # [C, T] int32
+        vj = (lj >= 0).astype(jnp.int32)
+        both = vi[:, :, None] * vj[:, None, :]                # [C, T, T] int32
+        eq = jnp.where(li[:, :, None] == lj[:, None, :], both, 0)
+        agree = agree + jnp.sum(eq, axis=0)
+        union = union + jnp.sum(both, axis=0)
         return agree, union
 
-    zero = jnp.zeros((TILE, TILE), jnp.int32)
-    agree, union = jax.lax.fori_loop(0, b_pad // BOOT_CHUNK, body, (zero, zero))
+    acc = (agree_ref[:], union_ref[:])
+    agree, union = jax.lax.fori_loop(0, boot_block // BOOT_CHUNK, body, acc)
+    agree_ref[:] = agree
+    union_ref[:] = union
 
-    jac = jnp.where(
-        union > 0,
-        agree.astype(jnp.float32) / jnp.maximum(union, 1).astype(jnp.float32),
-        0.0,
-    )
-    dist = 1.0 - jac
-    # zero the diagonal of diagonal-grid tiles
-    i, j = pl.program_id(0), pl.program_id(1)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
-    on_diag = (i == j) & (rows == cols)
-    out_ref[:] = jnp.where(on_diag, 0.0, dist)
+    @pl.when(b == nb - 1)
+    def _finalize():
+        jac = jnp.where(
+            union > 0,
+            agree.astype(jnp.float32) / jnp.maximum(union, 1).astype(jnp.float32),
+            0.0,
+        )
+        dist = 1.0 - jac
+        # zero the diagonal of diagonal-grid tiles
+        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+        on_diag = (i == j) & (rows == cols)
+        out_ref[:] = jnp.where(on_diag, 0.0, dist)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -71,28 +99,41 @@ def pallas_coclustering_distance(
     float32 co-clustering distance (diagonal 0, never-co-sampled pairs 1).
 
     Cluster ids must fit int8 (the engine's compact labels are bounded by
-    max_clusters <= 127; -1 is the mask). Pads B to BOOT_CHUNK and n to TILE
+    max_clusters <= 127; -1 is the mask). Pads B to BOOT_BLOCK and n to TILE
     with -1, which contribute nothing to either count.
     """
     labels = jnp.asarray(labels)
     b, n = labels.shape
-    b_pad = -(-b // BOOT_CHUNK) * BOOT_CHUNK
+    # block the boot axis to BOOT_CHUNK granularity, capped at BOOT_BLOCK —
+    # small B (robust mode: nboots ~ 100) pads to the next chunk, not to 512
+    boot_block = min(BOOT_BLOCK, -(-b // BOOT_CHUNK) * BOOT_CHUNK)
+    b_pad = -(-b // boot_block) * boot_block
     n_pad = -(-n // TILE) * TILE
     lab8 = jnp.full((b_pad, n_pad), -1, jnp.int8)
     lab8 = jax.lax.dynamic_update_slice(lab8, labels.astype(jnp.int8), (0, 0))
 
-    grid = (n_pad // TILE, n_pad // TILE)
+    # boot axis innermost: the (i, j) output block stays fixed in VMEM while
+    # boot blocks stream past the scratch accumulators.
+    grid = (n_pad // TILE, n_pad // TILE, b_pad // boot_block)
     out = pl.pallas_call(
         _cocluster_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((b_pad, TILE), lambda i, j: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((b_pad, TILE), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (boot_block, TILE), lambda i, j, b: (b, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (boot_block, TILE), lambda i, j, b: (b, j), memory_space=pltpu.VMEM
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (TILE, TILE), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            (TILE, TILE), lambda i, j, b: (i, j), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((TILE, TILE), jnp.int32),
+            pltpu.VMEM((TILE, TILE), jnp.int32),
+        ],
         cost_estimate=pl.CostEstimate(
             flops=2 * b_pad * n_pad * n_pad,
             bytes_accessed=2 * b_pad * n_pad * (n_pad // TILE) + 4 * n_pad * n_pad,

@@ -37,7 +37,9 @@ from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "k_list", "max_clusters", "n_iters", "n_cells"),
+    static_argnames=(
+        "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun"
+    ),
 )
 def sharded_run_bootstraps(
     keys: jax.Array,       # [B] per-boot PRNG keys
@@ -49,6 +51,7 @@ def sharded_run_bootstraps(
     max_clusters: int,
     n_cells: int,
     n_iters: int = 20,
+    cluster_fun: str = "leiden",
 ) -> Tuple[jax.Array, jax.Array]:
     """Robust-mode bootstraps over the mesh.
 
@@ -68,6 +71,7 @@ def sharded_run_bootstraps(
             grid = cluster_grid(
                 key_b, x, res_rep, k_list, jnp.float32(0.0),
                 max_clusters=max_clusters, n_iters=n_iters,
+                cluster_fun=cluster_fun,
             )
             best = ties_last_argmax(grid.scores)
             aligned = align_to_cells(grid.labels[best], idx_b, n_cells)

@@ -30,8 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from consensusclustr_tpu.cluster.engine import consensus_candidate_score
-from consensusclustr_tpu.cluster.leiden import compact_labels, leiden_fixed
+from consensusclustr_tpu.cluster.engine import (
+    community_detect,
+    consensus_candidate_score,
+)
+from consensusclustr_tpu.cluster.leiden import compact_labels
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
@@ -43,7 +46,8 @@ from consensusclustr_tpu.utils.rng import cluster_key
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "ki", "n_res", "max_clusters", "n_iters")
+    jax.jit,
+    static_argnames=("mesh", "ki", "n_res", "max_clusters", "n_iters", "cluster_fun"),
 )
 def _consensus_grid_sharded(
     keys: jax.Array,       # [R] PRNG keys (global resolution order)
@@ -56,8 +60,9 @@ def _consensus_grid_sharded(
     n_res: int,
     max_clusters: int,
     n_iters: int = 20,
+    cluster_fun: str = "leiden",
 ) -> Tuple[jax.Array, jax.Array]:
-    """Leiden over the resolution sweep, res axis sharded over the flattened
+    """Leiden/Louvain over the resolution sweep, res axis sharded over the flattened
     ("boot", "cell") mesh — every device owns distinct resolutions.
 
     Returns (labels [R, n] int32, scores [R] with -inf at padding).
@@ -68,7 +73,7 @@ def _consensus_grid_sharded(
         graph = snn_graph(idx_rep)
 
         def one_res(kk, res, mask):
-            raw = leiden_fixed(kk, graph, res, n_iters=n_iters)
+            raw = community_detect(kk, graph, res, cluster_fun, n_iters=n_iters)
             compact, n_c, overflow = compact_labels(raw, max_clusters)
             score = consensus_candidate_score(pca_rep, compact, n_c, overflow, max_clusters)
             return compact, jnp.where(mask > 0, score, -jnp.inf)
@@ -93,7 +98,9 @@ class DistributedStepResult(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "k_list", "max_clusters", "n_iters", "n_res_real"),
+    static_argnames=(
+        "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun"
+    ),
 )
 def distributed_consensus_step(
     key: jax.Array,
@@ -107,6 +114,7 @@ def distributed_consensus_step(
     max_clusters: int,
     n_res_real: int,
     n_iters: int = 20,
+    cluster_fun: str = "leiden",
 ) -> DistributedStepResult:
     n, _ = pca.shape
     b_pad = idx.shape[0]
@@ -114,7 +122,7 @@ def distributed_consensus_step(
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(b_pad))
     boot_labels, _ = sharded_run_bootstraps(
         keys, idx, pca, res_list[:n_res_real], mesh, k_list,
-        max_clusters, n, n_iters=n_iters,
+        max_clusters, n, n_iters=n_iters, cluster_fun=cluster_fun,
     )
     # padding boots contribute nothing to the co-clustering counts
     boot_labels = jnp.where(
@@ -132,7 +140,7 @@ def distributed_consensus_step(
         )(jnp.arange(r_pad))
         labels_k, scores_k = _consensus_grid_sharded(
             gkeys, knn_idx, pca, res_list, res_mask, mesh, ki, r_pad,
-            max_clusters, n_iters,
+            max_clusters, n_iters, cluster_fun=cluster_fun,
         )
         all_labels.append(labels_k)
         all_scores.append(scores_k)
@@ -180,6 +188,7 @@ def distributed_consensus_cluster(
     out = distributed_consensus_step(
         key, pca, idx, res_arr, res_mask, jnp.int32(cfg.nboots), mesh,
         tuple(int(k) for k in cfg.k_num), cfg.max_clusters, r_real,
+        cluster_fun=cfg.cluster_fun,
     )
     return (
         np.asarray(out.labels),

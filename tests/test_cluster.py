@@ -266,3 +266,63 @@ def test_candidate_selection_prefers_good_clustering():
     )
     assert len(np.unique(labels)) >= 2
     assert adjusted_rand_score(truth, labels) > 0.95
+
+
+# ---------- louvain ----------
+
+def test_louvain_recovers_planted_blobs():
+    from consensusclustr_tpu.cluster import louvain_fixed
+
+    x, truth = make_blobs(n_per=50, n_genes=8, n_clusters=3, sep=8.0, seed=12)
+    idx, _ = knn_points(jnp.asarray(x), 10)
+    g = snn_graph(idx)
+    labels = louvain_fixed(jax.random.key(0), g, 0.5)
+    compact, n_c, overflow = compact_labels(labels, 64)
+    ari = adjusted_rand_score(truth, np.asarray(compact))
+    assert not bool(overflow)
+    assert ari > 0.98, f"ARI={ari}, n_clusters={int(n_c)}"
+
+
+def test_louvain_modularity_parity_with_leiden():
+    # VERDICT r2 item 4: louvain must be a real algorithm of comparable
+    # quality, not an alias — modularity within 5% of the leiden variant on
+    # shared graphs.
+    from consensusclustr_tpu.cluster import louvain_fixed
+
+    for seed in (13, 14):
+        x, _ = make_blobs(n_per=40, n_genes=6, n_clusters=4, sep=6.0, seed=seed)
+        idx, _ = knn_points(jnp.asarray(x), 10)
+        g = snn_graph(idx)
+        q_lou = float(modularity(g, louvain_fixed(jax.random.key(1), g, 1.0), 1.0))
+        q_lei = float(modularity(g, leiden_fixed(jax.random.key(1), g, 1.0), 1.0))
+        assert q_lou >= 0.95 * q_lei, (q_lou, q_lei)
+
+
+def test_louvain_is_distinct_from_leiden():
+    # same key, same graph: the two algorithms traverse different code paths
+    # (louvain: dense coarse-level moves; leiden: best-partner merge), so at
+    # least one resolution should produce a different partition.
+    from consensusclustr_tpu.cluster import louvain_fixed
+
+    x, _ = make_blobs(n_per=40, n_genes=6, n_clusters=4, sep=4.0, seed=15)
+    idx, _ = knn_points(jnp.asarray(x), 10)
+    g = snn_graph(idx)
+    any_diff = False
+    for res in (0.3, 0.8, 1.5):
+        a = np.asarray(louvain_fixed(jax.random.key(3), g, res))
+        b = np.asarray(leiden_fixed(jax.random.key(3), g, res))
+        ca, _, _ = compact_labels(jnp.asarray(a), 64)
+        cb, _, _ = compact_labels(jnp.asarray(b), 64)
+        if not np.array_equal(np.asarray(ca), np.asarray(cb)):
+            any_diff = True
+    assert any_diff
+
+
+def test_cluster_fun_threads_through_engine():
+    x, truth = make_blobs(n_per=40, n_genes=8, n_clusters=3, sep=8.0, seed=16)
+    for fun in ("leiden", "louvain"):
+        labels, score = get_clust_assignments(
+            x, cluster_fun=fun, res_range=(0.1, 0.5), k_num=(10,), seed=1
+        )
+        ari = adjusted_rand_score(truth, labels)
+        assert ari > 0.9, (fun, ari)

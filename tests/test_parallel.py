@@ -163,3 +163,73 @@ def test_distributed_step_mesh_invariance():
     la, _, _ = distributed_consensus_cluster(key, pca, cfg, consensus_mesh(boot=8, cell=1))
     lb, _, _ = distributed_consensus_cluster(key, pca, cfg, consensus_mesh(boot=2, cell=4))
     np.testing.assert_array_equal(la, lb)
+
+
+def _nb_counts(n_per=64, n_genes=100, n_clusters=3, seed=21, fold=6.0):
+    r = np.random.default_rng(seed)
+    base = r.uniform(0.5, 2.0, size=n_genes)
+    counts = []
+    block = n_genes // n_clusters
+    for c in range(n_clusters):
+        mu = base.copy()
+        mu[c * block : (c + 1) * block] *= fold
+        lam = r.gamma(shape=4.0, scale=mu / 4.0, size=(n_per, n_genes))
+        counts.append(r.poisson(lam))
+    return np.concatenate(counts).astype(np.float32)
+
+
+def test_consensus_clust_mesh_bit_identical():
+    """VERDICT r2 item 2: the PUBLIC pipeline (bootstraps -> co-clustering ->
+    consensus grid -> small-cluster merge -> stability merge -> gate) must
+    produce bit-identical assignments on a 1-device and an 8-device mesh."""
+    from consensusclustr_tpu.api import consensus_clust
+
+    counts = _nb_counts()
+    kw = dict(
+        nboots=8, n_var_features=60, pc_num=6, min_size=10,
+        k_num=(5, 10), res_range=(0.05, 0.3, 0.8), max_clusters=16, seed=5,
+    )
+    mesh1 = consensus_mesh(devices=jax.devices()[:1], boot=1, cell=1)
+    mesh8 = consensus_mesh(boot=4, cell=2)
+    a = consensus_clust(counts, mesh=mesh1, **kw).assignments
+    b = consensus_clust(counts, mesh=mesh8, **kw).assignments
+    assert len(set(a.tolist())) > 1, "fixture should yield real structure"
+    np.testing.assert_array_equal(a, b)
+
+
+def test_consensus_clust_mesh_matches_single_chip_structure():
+    """The distributed dispatch recovers the same cluster structure as the
+    single-chip path (selection may differ on distance ties, so compare
+    partitions by ARI rather than labels)."""
+    from consensusclustr_tpu.api import consensus_clust
+
+    counts = _nb_counts(seed=22)
+    kw = dict(
+        nboots=8, n_var_features=60, pc_num=6, min_size=10,
+        k_num=(5, 10), res_range=(0.05, 0.3, 0.8), max_clusters=16, seed=5,
+    )
+    single = consensus_clust(counts, **kw).assignments
+    dist = consensus_clust(counts, mesh="auto", **kw).assignments
+    ua, ia = np.unique(single, return_inverse=True)
+    ub, ib = np.unique(dist, return_inverse=True)
+    ct = np.zeros((len(ua), len(ub)))
+    np.add.at(ct, (ia, ib), 1)
+    comb = lambda x: x * (x - 1) / 2.0
+    sum_ij = comb(ct).sum(); sum_a = comb(ct.sum(1)).sum(); sum_b = comb(ct.sum(0)).sum()
+    n = comb(len(single)); exp = sum_a * sum_b / n; mx = 0.5 * (sum_a + sum_b)
+    ari = (sum_ij - exp) / (mx - exp) if mx != exp else 1.0
+    assert ari > 0.95, ari
+
+
+def test_mesh_fallback_granular_and_indivisible():
+    """Shapes that cannot shard fall back to single-chip instead of raising."""
+    from consensusclustr_tpu.consensus.pipeline import _resolve_mesh
+    from consensusclustr_tpu.config import ClusterConfig
+
+    mesh = consensus_mesh(boot=4, cell=2)
+    cfg = ClusterConfig(nboots=4, mesh=mesh)
+    assert _resolve_mesh(cfg, 64) is mesh
+    assert _resolve_mesh(cfg.replace(mode="granular"), 64) is None
+    assert _resolve_mesh(cfg.replace(nboots=0), 64) is None
+    assert _resolve_mesh(cfg, 63) is None   # 63 % 2 != 0
+    assert _resolve_mesh(cfg.replace(mesh=None), 64) is None

@@ -91,6 +91,34 @@ def _densify(x) -> np.ndarray:
     return np.asarray(x, dtype=np.float32)
 
 
+def _is_sparse(x) -> bool:
+    from consensusclustr_tpu.prep.sparse import is_sparse
+
+    return x is not None and is_sparse(x)
+
+
+def _sparse_or_dense(x):
+    """Keep sparse counts sparse (scipy CSR) through ingestion; the dense
+    materialisation happens only after the HVG subset (prep/sparse.py — the
+    reference's dgCMatrix-end-to-end memory profile, SURVEY §2.2)."""
+    from consensusclustr_tpu.prep.sparse import is_sparse, to_csr
+
+    if is_sparse(x) or (
+        hasattr(x, "indptr") and hasattr(x, "col") and hasattr(x, "val")
+    ):
+        return to_csr(x)  # scipy sparse or io.CountMatrix
+    return np.asarray(x, dtype=np.float32)
+
+
+def _dense_cols(x, mask: Optional[np.ndarray]) -> np.ndarray:
+    """Dense float32 [n, sum(mask)] column subset of dense or sparse counts."""
+    if _is_sparse(x):
+        sub = x[:, mask] if mask is not None else x
+        return np.asarray(sub.todense(), np.float32)
+    x = np.asarray(x, np.float32)
+    return x[:, mask] if mask is not None else x
+
+
 def _encode_covariates(cols: List[np.ndarray]) -> np.ndarray:
     """Stack covariate columns, one-hot (drop-first) for non-numeric ones.
 
@@ -128,22 +156,30 @@ def _ingest_anndata(adata, cfg: ClusterConfig) -> _Ingested:
       * vars_to_regress names resolve against obs columns (:209-214, :251-257).
     """
     layers = getattr(adata, "layers", {}) or {}
+    # Assay-scoped lookup (reference :231 `obj[[assay]]$counts`): layers named
+    # "<assay>_counts"/"<assay>_data"/"<assay>_scale_data" take precedence
+    # over the generic names, so multi-assay AnnData objects (CITE-seq etc.)
+    # can address one assay the way Seurat's `assay` argument does.
+    a = cfg.assay
     counts = None
-    for name in ("counts",):
+    for name in (f"{a}_counts", "counts"):
         if name in layers:
-            counts = _densify(layers[name])
+            counts = _sparse_or_dense(layers[name])
             break
     if counts is None and getattr(adata, "raw", None) is not None:
-        counts = _densify(adata.raw.X)
+        counts = _sparse_or_dense(adata.raw.X)
     norm = None
     scale_data = False
-    if "scale_data" in layers:
+    scale_names = (f"{a}_scale_data", "scale_data")
+    norm_names = (f"{a}_logcounts", f"{a}_data", "logcounts", "data")
+    if any(name in layers for name in scale_names):
         # Seurat scale.data semantics (:223-228): already HVG-subset and
         # regressed, so _level skips both steps downstream
-        norm = _densify(layers["scale_data"])
+        key_name = next(name for name in scale_names if name in layers)
+        norm = _densify(layers[key_name])
         scale_data = True
     else:
-        for name in ("logcounts", "data"):
+        for name in norm_names:
             if name in layers:
                 norm = _densify(layers[name])
                 break
@@ -194,12 +230,12 @@ def _ingest(data, cfg: ClusterConfig, norm_counts=None, pca=None) -> _Ingested:
     if _is_anndata_like(data):
         ing = _ingest_anndata(data, cfg)
         if norm_counts is not None:
-            ing.norm_counts = _densify(norm_counts)
+            ing.norm_counts = _sparse_or_dense(norm_counts)
         if pca is not None:
             ing.pca = np.asarray(pca, np.float32)
         return ing
 
-    counts = _densify(data) if data is not None else None
+    counts = _sparse_or_dense(data) if data is not None else None
     cov = None
     if cfg.vars_to_regress is not None:
         cov = np.asarray(cfg.vars_to_regress, dtype=np.float32)
@@ -208,7 +244,7 @@ def _ingest(data, cfg: ClusterConfig, norm_counts=None, pca=None) -> _Ingested:
     gene_names = getattr(data, "gene_names", None)  # io.CountMatrix carries names
     return _Ingested(
         counts=counts,
-        norm_counts=_densify(norm_counts) if norm_counts is not None else None,
+        norm_counts=_sparse_or_dense(norm_counts) if norm_counts is not None else None,
         pca=np.asarray(pca, np.float32) if pca is not None else None,
         variable_features=hvg,
         covariates=cov,
@@ -324,7 +360,14 @@ def _level(
         return _single_cluster(n), None, None
     cfg = cfg.replace(k_num=k_list)
 
-    counts_dev = jnp.asarray(ing.counts, jnp.float32) if ing.counts is not None else None
+    # Sparse counts stay scipy CSR through size factors + HVG selection
+    # (prep/sparse.py); dense counts go straight to device.
+    sparse_counts = _is_sparse(ing.counts)
+    counts_dev = (
+        jnp.asarray(ing.counts, jnp.float32)
+        if ing.counts is not None and not sparse_counts
+        else None
+    )
     sf = None
 
     # Provided-PCA gate, decided up front: when honored, the whole
@@ -341,35 +384,56 @@ def _level(
     if use_given_pca:
         norm = None
     elif ing.norm_counts is not None:
-        norm = jnp.asarray(ing.norm_counts, jnp.float32)
+        norm = (
+            ing.norm_counts
+            if _is_sparse(ing.norm_counts)
+            else jnp.asarray(ing.norm_counts, jnp.float32)
+        )
     else:
-        if counts_dev is None:
+        if ing.counts is None:
             raise ValueError(
                 "need counts or norm_counts (or a precomputed pca with a "
                 "numeric pc_num <= 30)"
             )
-        sf = compute_size_factors(counts_dev, cfg.size_factors)
-        norm = shifted_log(counts_dev, sf)
+        if sparse_counts:
+            from consensusclustr_tpu.prep.sparse import (
+                compute_size_factors_sparse,
+                sparse_shifted_log,
+            )
+
+            sf_np = compute_size_factors_sparse(ing.counts, cfg.size_factors)
+            sf = jnp.asarray(sf_np)
+            norm = sparse_shifted_log(ing.counts, sf_np)  # stays CSR
+        else:
+            sf = compute_size_factors(counts_dev, cfg.size_factors)
+            norm = shifted_log(counts_dev, sf)
 
     # --- HVG selection (:291-304) -----------------------------------------
     n_genes = ing.counts.shape[1] if ing.counts is not None else (
         norm.shape[1] if norm is not None else 0
     )
     hvg_mask = _resolve_hvg_mask(ing.variable_features, ing.gene_names, n_genes)
-    if hvg_mask is None and not ing.scale_data and counts_dev is not None:
+    if hvg_mask is None and not ing.scale_data and ing.counts is not None:
         n_hvg = min(cfg.n_var_features, n_genes)
-        hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
-    if hvg_mask is not None and not ing.scale_data:
-        # scale.data input skips the HVG subset — Seurat already did (:301)
-        if norm is not None:
-            norm = norm[:, np.asarray(hvg_mask)]
-        counts_hvg = (
-            np.asarray(ing.counts)[:, np.asarray(hvg_mask)]
-            if ing.counts is not None
-            else None
-        )
+        if sparse_counts:
+            from consensusclustr_tpu.prep.sparse import sparse_select_hvgs
+
+            hvg_mask = sparse_select_hvgs(ing.counts, n_hvg)
+        else:
+            hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
+    if hvg_mask is not None:
+        mask_np = np.asarray(hvg_mask)
+        if norm is not None and not ing.scale_data:
+            # scale.data input skips the norm HVG subset — Seurat already did
+            # (:301); the null-test counts are HVG-subset either way (:526)
+            norm = norm[:, mask_np]
+        counts_hvg = _dense_cols(ing.counts, mask_np) if ing.counts is not None else None
     else:
-        counts_hvg = np.asarray(ing.counts) if ing.counts is not None else None
+        counts_hvg = _dense_cols(ing.counts, None) if ing.counts is not None else None
+    # the dense device path starts here: post-HVG the matrix is
+    # [n, n_var_features] and safely materialisable
+    if _is_sparse(norm):
+        norm = jnp.asarray(np.asarray(norm.todense(), np.float32))
     log.event("prep", n_genes_kept=int(norm.shape[1]) if norm is not None else 0)
 
     # --- covariate regression (:306-319) ----------------------------------
@@ -387,10 +451,16 @@ def _level(
         log.event("regressed", method=cfg.regress_method)
 
     # --- PCA + pcNum (:321-382) -------------------------------------------
+    # The elbow prompt covers both "find" and the numeric pc_num > 30 case —
+    # the latter silently re-enters the find path (reference :338, quirk 3),
+    # so an interactive user should get the same say over the outcome.
+    wants_find = cfg.pc_num == "find" or (
+        not isinstance(cfg.pc_num, str) and int(cfg.pc_num) > 30
+    )
     if (
         cfg.interactive
         and depth == 1
-        and cfg.pc_num == "find"
+        and wants_find
         and norm is not None
         and not use_given_pca
     ):
@@ -439,7 +509,7 @@ def _level(
                 key=cluster_key(key, "nulltest"),
                 test_separately=cfg.test_splits_separately,
                 max_clusters=cfg.max_clusters, log=log,
-                cluster_fun=cfg.cluster_fun,
+                cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
             )
             labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))

@@ -115,7 +115,10 @@ def community_detect(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun"),
+    static_argnames=(
+        "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
+        "compute_dtype",
+    ),
 )
 def cluster_grid(
     key: jax.Array,
@@ -127,6 +130,7 @@ def cluster_grid(
     n_iters: int = 20,
     update_frac: float = 0.5,
     cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set.
 
@@ -140,7 +144,7 @@ def cluster_grid(
 
     all_labels, all_nc, all_scores = [], [], []
     for ki, k in enumerate(k_list):
-        idx, _ = knn_points(x, k)
+        idx, _ = knn_points(x, k, compute_dtype=compute_dtype)
         graph = snn_graph(idx)
         keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
 

@@ -97,7 +97,10 @@ class ClusterConfig:
     compute_dtype: str = "float32"
     use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
     progress: bool = False      # structured per-level logging
-    checkpoint_dir: Optional[str] = None  # persist boot chunks; resume on rerun
+    # Persist boot chunks; resume on rerun. Single-chip robust mode only —
+    # the distributed step is one fused program with no chunk boundary to
+    # checkpoint at (a "checkpoint_skipped" log event records the drop).
+    checkpoint_dir: Optional[str] = None
     # Distributed execution: None = single chip; "auto" = shard over all
     # visible devices when >1; or an explicit jax.sharding.Mesh built by
     # parallel.mesh.consensus_mesh. The pipeline falls back to single-chip
@@ -125,6 +128,10 @@ class ClusterConfig:
             raise ValueError("size_factors must be 'deconvolution', 'libsize' or a vector")
         if not (0.0 < self.pc_var <= 1.0):
             raise ValueError("pc_var must be in (0, 1]")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16'; got {self.compute_dtype!r}"
+            )
         if self.nboots < 0 or self.min_size < 0 or self.n_var_features <= 0:
             raise ValueError("nboots/min_size must be >= 0, n_var_features > 0")
         if self.mesh is not None and not (

@@ -62,7 +62,7 @@ class ConsensusResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
-        "cluster_fun",
+        "cluster_fun", "compute_dtype",
     ),
 )
 def _boot_batch(
@@ -78,6 +78,7 @@ def _boot_batch(
     robust: bool,
     n_cells: int,
     cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
 ):
     """One jitted chunk of bootstraps: gather -> grid -> select -> align."""
 
@@ -86,6 +87,7 @@ def _boot_batch(
         grid = cluster_grid(
             key_b, x, res_list, k_list, min_size,
             max_clusters=max_clusters, n_iters=n_iters, cluster_fun=cluster_fun,
+            compute_dtype=compute_dtype,
         )
         if robust:
             best = _ties_last_argmax(grid.scores)
@@ -104,18 +106,26 @@ def _auto_boot_chunk(
     if requested > 0:
         return max(1, min(requested, nboots))
     # Bound the per-chunk workspace: the blockwise kNN row tile plus the
-    # Leiden local-move working set — ~8 [m, e] f32 arrays per resolution
-    # (sort/cumsum/gather buffers), vmapped over n_res (e = 2k edge slots).
-    # The TPU runtime hard-crashes (not OOMs gracefully) when pushed, so
-    # track a conservative budget against the 16 GB HBM.
+    # Leiden local-move working set per resolution — the [m, slab, e]
+    # equality-slab transient plus ~8 [m, e] gather/gain buffers (e = 2k edge
+    # slots), vmapped over n_res. The TPU runtime hard-crashes (not OOMs
+    # gracefully) when pushed, so track a conservative budget against the
+    # 16 GB HBM.
     from consensusclustr_tpu.cluster.knn import KNN_BLOCK
+    from consensusclustr_tpu.cluster.leiden import _SLAB
 
     e = 2 * k_max
     knn_bytes = (m * m if m <= 2 * KNN_BLOCK else KNN_BLOCK * m) * 4.0
-    per_boot = knn_bytes + n_res * m * e * 4.0 * 8.0
-    default_budget = 2e9 if jax.default_backend() == "cpu" else 6e9
-    budget = float(os.environ.get("CCTPU_CHUNK_BYTES", default_budget))
-    return int(max(1, min(nboots, budget // max(per_boot, 1.0), 64)))
+    per_boot = knn_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
+    on_cpu = jax.default_backend() == "cpu"
+    budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9 if on_cpu else 6e9))
+    # TPU cap: XLA compile time grows superlinearly with the vmapped boot
+    # axis, and the serving tunnel kills calls that stall past ~2 min — a
+    # chunk of 8 compiles in ~70 s and is also the warm-throughput sweet spot
+    # (larger chunks LOWER boots/sec; measured on v5e). CCTPU_MAX_CHUNK
+    # overrides for untunneled pods.
+    cap = int(os.environ.get("CCTPU_MAX_CHUNK", 64 if on_cpu else 8))
+    return int(max(1, min(nboots, budget // max(per_boot, 1.0), cap)))
 
 
 def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None):
@@ -153,6 +163,10 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
                 "nboots": cfg.nboots, "boot_size": cfg.boot_size,
                 "k_num": list(k_list), "res_range": list(cfg.res_range),
                 "max_clusters": cfg.max_clusters, "chunk": chunk,
+                # anything _boot_batch's output depends on must be hashed, or
+                # a resume silently reuses chunks from a different algorithm
+                "cluster_fun": cfg.cluster_fun,
+                "compute_dtype": cfg.compute_dtype,
             },
             np.asarray(jax.random.key_data(key)).tobytes(),
         )
@@ -177,7 +191,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
             keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
             jnp.float32(0.0),
             len(cfg.res_range), cfg.max_clusters, 20, robust, n,
-            cfg.cluster_fun,
+            cfg.cluster_fun, cfg.compute_dtype,
         )
         out_labels.append(np.asarray(labels))
         out_scores.append(np.asarray(scores))
@@ -329,6 +343,10 @@ def consensus_cluster(
             distributed_consensus_cluster,
         )
 
+        if cfg.checkpoint_dir and log:
+            # the fused sharded step has no per-chunk boundary to persist at;
+            # surface the contract change instead of silently dropping it
+            log.event("checkpoint_skipped", reason="distributed step is fused")
         labels_np, dist_np, boot_labels = distributed_consensus_cluster(
             key, pca, cfg, mesh
         )
@@ -348,6 +366,7 @@ def consensus_cluster(
         grid = cluster_grid(
             key, pca, res_list, k_list, jnp.float32(0.0),
             max_clusters=cfg.max_clusters, cluster_fun=cfg.cluster_fun,
+            compute_dtype=cfg.compute_dtype,
         )
         best = int(_ties_last_argmax(grid.scores))
         labels = np.asarray(grid.labels[best])

@@ -113,9 +113,24 @@ void* cc_mtx_open(const char* path, int64_t* rows, int64_t* cols, int64_t* nnz) 
     if (!*p || *p == '%') continue;
     char* end = nullptr;
     const long ri = std::strtol(p, &end, 10);
-    const long ci = std::strtol(end, &end, 10);
+    if (end == p) { std::fclose(f); delete m; return nullptr; }
+    const char* mid = end;
+    const long ci = std::strtol(mid, &end, 10);
+    if (end == mid) { std::fclose(f); delete m; return nullptr; }
     double val = 1.0;
-    if (!pattern) val = std::strtod(end, &end);
+    if (!pattern) {
+      const char* vp = end;
+      val = std::strtod(vp, &end);
+      if (end == vp) { std::fclose(f); delete m; return nullptr; }
+    }
+    // 1-based indices must land inside the declared dims: cc_coo_to_csr
+    // scatter-writes with them, so out-of-range entries are memory-unsafe,
+    // not just wrong (ADVICE r1 item 1).
+    if (ri < 1 || ri > nr || ci < 1 || ci > nc) {
+      std::fclose(f);
+      delete m;
+      return nullptr;
+    }
     m->r.push_back((int32_t)(ri - 1));  // MatrixMarket is 1-based
     m->c.push_back((int32_t)(ci - 1));
     m->v.push_back((float)val);

@@ -45,7 +45,7 @@ from consensusclustr_tpu.utils.rng import sim_key
     jax.jit,
     static_argnames=(
         "n_cells", "pc_num", "k_list", "pool_sizes", "max_clusters", "has_cov",
-        "cluster_fun",
+        "cluster_fun", "compute_dtype",
     ),
 )
 def _null_stat_batch(
@@ -60,6 +60,7 @@ def _null_stat_batch(
     max_clusters: int,
     has_cov: bool,
     cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
 ) -> jax.Array:
     def one(key):
         k_sim, k_pca, k_clu = jax.random.split(key, 3)
@@ -77,7 +78,7 @@ def _null_stat_batch(
         grid = cluster_grid(
             k_clu, pca, res_list, k_list,
             jnp.float32(NULL_SIM_MIN_SIZE), max_clusters=max_clusters,
-            cluster_fun=cluster_fun,
+            cluster_fun=cluster_fun, compute_dtype=compute_dtype,
         )
         best = _ties_last_argmax(grid.scores)
         labels = grid.labels[best]
@@ -102,6 +103,7 @@ def generate_null_statistics(
     chunk: int = 4,
     cluster_fun: str = "leiden",
     res_range=None,
+    compute_dtype: str = "float32",
 ) -> np.ndarray:
     """n_sims null silhouettes, chunk-vmapped on device.
 
@@ -132,7 +134,7 @@ def generate_null_statistics(
                 _null_stat_batch(
                     keys[s:e], model, cov, res_list,
                     int(n_cells), int(pc_num), k_list, pool_sizes,
-                    int(max_clusters), has_cov, cluster_fun,
+                    int(max_clusters), has_cov, cluster_fun, compute_dtype,
                 )
             )
         )

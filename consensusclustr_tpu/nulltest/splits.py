@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensusclustr_tpu.config import TEST_SPLITS_RES_RANGE
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
 from consensusclustr_tpu.linalg.distance import euclidean_distance_matrix as _euclidean
@@ -69,6 +70,7 @@ def _clustering_rejected(
     log: Optional[LevelLog],
     cluster_fun: str = "leiden",
     res_range=None,
+    compute_dtype: str = "float32",
 ) -> tuple:
     """One full adaptive null test.
 
@@ -83,6 +85,7 @@ def _clustering_rejected(
         key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
         covariates=covariates, max_clusters=max_clusters, round_id=0,
         cluster_fun=cluster_fun, res_range=res_range,
+        compute_dtype=compute_dtype,
     )
     p = null_p_value(silhouette, stats)
     # Adaptive refinement near the boundary (reference :943-964): +20 sims if
@@ -94,6 +97,7 @@ def _clustering_rejected(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
                 covariates=covariates, max_clusters=max_clusters, round_id=1,
                 cluster_fun=cluster_fun, res_range=res_range,
+                compute_dtype=compute_dtype,
             ),
         ])
         p = null_p_value(silhouette, stats)
@@ -104,6 +108,7 @@ def _clustering_rejected(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
                 covariates=covariates, max_clusters=max_clusters, round_id=2,
                 cluster_fun=cluster_fun, res_range=res_range,
+                compute_dtype=compute_dtype,
             ),
         ])
         p = null_p_value(silhouette, stats)
@@ -135,15 +140,20 @@ def test_splits(
     log: Optional[LevelLog] = None,
     cluster_fun: str = "leiden",
     res_range=None,
+    compute_dtype: str = "float32",
 ) -> np.ndarray:
     """Public API mirroring the reference export (NAMESPACE:6; :891).
 
     `cluster_fun` flows into the null-sim clusterings, as the reference's
     clusterFun does via testSplits' `...` (:536-537 -> :935 -> :803).
-    `res_range` mirrors the reference signature's resRange (:892); there it is
-    shadowed by generateNullStatistic's hardcoded sweep, so None (default)
-    reproduces reference behavior and a sequence actually overrides the
-    null-sim sweep (documented intent-fix, docs/quirks.md).
+    `res_range` mirrors the reference signature's resRange (:892). In the
+    reference that parameter is never consumed — generateNullStatistic
+    hardcodes its own sweep (:803), and forwarding resRange through `...`
+    would be a duplicate-argument error — so None (default) reproduces
+    reference behavior; a sequence actually overrides the null-sim sweep, and
+    the string "signature" resolves to the reference signature's documented
+    default seq(0.1, 3.4, 0.15) (config.TEST_SPLITS_RES_RANGE) — both
+    intent-fixes, docs/quirks.md.
 
     counts: [n_cells, n_hvg] raw counts (the reference builds an SCE of HVG
     counts, :526-531). pca: [n_cells, d]. assignments: per-cell labels.
@@ -151,6 +161,12 @@ def test_splits(
     (test_separately=False, :967-970), or with individual failed splits
     collapsed (test_separately=True).
     """
+    if isinstance(res_range, str):
+        if res_range != "signature":
+            raise ValueError(
+                f"res_range must be None, 'signature' or a sequence; got {res_range!r}"
+            )
+        res_range = TEST_SPLITS_RES_RANGE
     assignments = np.asarray(assignments, dtype=object)
     n = len(assignments)
     if key is None:
@@ -173,6 +189,7 @@ def test_splits(
             alpha=alpha, k_num=k_num, covariates=covariates,
             n_sims=n_sims, max_clusters=max_clusters, log=log,
             cluster_fun=cluster_fun, res_range=res_range,
+            compute_dtype=compute_dtype,
         )
         if rejected:
             return np.full(n, "1", dtype=object)
@@ -184,6 +201,7 @@ def test_splits(
         silhouette_thresh=silhouette_thresh, covariates=covariates,
         n_sims=n_sims, max_clusters=max_clusters, log=log, depth=0,
         cluster_fun=cluster_fun, res_range=res_range,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -221,6 +239,7 @@ def _test_tree(
     depth: int,
     cluster_fun: str = "leiden",
     res_range=None,
+    compute_dtype: str = "float32",
 ) -> np.ndarray:
     """Per-split walk (reference :894-905, 966-1036): test this subtree's top
     split; on failure, softly merge the majority cluster of each branch and
@@ -243,6 +262,7 @@ def _test_tree(
             alpha=alpha, k_num=k_num, covariates=covariates,
             n_sims=n_sims, max_clusters=max_clusters, log=log,
             cluster_fun=cluster_fun, res_range=res_range,
+            compute_dtype=compute_dtype,
         )
         # Failed split: merge the majority cluster of each branch into one
         # cluster, rebuild the dendrogram from Euclidean PCA distances, and
@@ -291,5 +311,6 @@ def _test_tree(
             silhouette_thresh=silhouette_thresh, covariates=cov_sub,
             n_sims=n_sims, max_clusters=max_clusters, log=log, depth=depth + 1,
             cluster_fun=cluster_fun, res_range=res_range,
+            compute_dtype=compute_dtype,
         )
     return labels

@@ -38,7 +38,8 @@ from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun"
+        "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun",
+        "compute_dtype"
     ),
 )
 def sharded_run_bootstraps(
@@ -52,6 +53,7 @@ def sharded_run_bootstraps(
     n_cells: int,
     n_iters: int = 20,
     cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
 ) -> Tuple[jax.Array, jax.Array]:
     """Robust-mode bootstraps over the mesh.
 
@@ -71,7 +73,7 @@ def sharded_run_bootstraps(
             grid = cluster_grid(
                 key_b, x, res_rep, k_list, jnp.float32(0.0),
                 max_clusters=max_clusters, n_iters=n_iters,
-                cluster_fun=cluster_fun,
+                cluster_fun=cluster_fun, compute_dtype=compute_dtype,
             )
             best = ties_last_argmax(grid.scores)
             aligned = align_to_cells(grid.labels[best], idx_b, n_cells)

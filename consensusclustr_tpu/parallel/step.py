@@ -99,7 +99,8 @@ class DistributedStepResult(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun"
+        "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun",
+        "compute_dtype",
     ),
 )
 def distributed_consensus_step(
@@ -115,6 +116,7 @@ def distributed_consensus_step(
     n_res_real: int,
     n_iters: int = 20,
     cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
 ) -> DistributedStepResult:
     n, _ = pca.shape
     b_pad = idx.shape[0]
@@ -123,6 +125,7 @@ def distributed_consensus_step(
     boot_labels, _ = sharded_run_bootstraps(
         keys, idx, pca, res_list[:n_res_real], mesh, k_list,
         max_clusters, n, n_iters=n_iters, cluster_fun=cluster_fun,
+        compute_dtype=compute_dtype,
     )
     # padding boots contribute nothing to the co-clustering counts
     boot_labels = jnp.where(
@@ -188,7 +191,7 @@ def distributed_consensus_cluster(
     out = distributed_consensus_step(
         key, pca, idx, res_arr, res_mask, jnp.int32(cfg.nboots), mesh,
         tuple(int(k) for k in cfg.k_num), cfg.max_clusters, r_real,
-        cluster_fun=cfg.cluster_fun,
+        cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
     )
     return (
         np.asarray(out.labels),

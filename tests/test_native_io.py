@@ -119,3 +119,35 @@ def test_load_npz_sparse(tmp_path):
     sp.save_npz(path, sp.csr_matrix(dense.astype(np.float32)))
     cm = load_counts(str(path))
     np.testing.assert_array_equal(cm.dense(), dense.astype(np.float32))
+
+
+def test_mtx_out_of_range_indices_raise(tmp_path, monkeypatch):
+    """Malformed files must raise cleanly under BOTH toolchains: entries
+    outside the declared dims would make cc_coo_to_csr scatter-write out of
+    bounds (ADVICE r1 item 1), so the native parser rejects them up front,
+    converging with the scipy fallback's ValueError."""
+    path = tmp_path / "bad.mtx"
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate integer general\n")
+        f.write("3 3 2\n")
+        f.write("1 1 5\n")
+        f.write("7 2 1\n")  # row 7 > declared 3 rows
+
+    with pytest.raises(ValueError):
+        read_mtx(str(path))  # native path (or fallback if no toolchain)
+
+    import consensusclustr_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "load_library", lambda: None)
+    with pytest.raises(ValueError):
+        native_mod.read_mtx(str(path))  # forced scipy fallback
+
+
+def test_mtx_garbage_line_raises(tmp_path):
+    path = tmp_path / "garbled.mtx"
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate real general\n")
+        f.write("2 2 1\n")
+        f.write("1 x 1.0\n")  # non-numeric column index
+    with pytest.raises(ValueError):
+        read_mtx(str(path))

@@ -1,0 +1,172 @@
+"""Sparse prep path: parity with the dense kernels, sparse end-to-end runs,
+and the round-3 knob wiring (assay, compute_dtype, test_splits res_range)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import jax.numpy as jnp
+
+from consensusclustr_tpu.prep.hvg import binomial_deviance, poisson_deviance, select_hvgs
+from consensusclustr_tpu.prep.sizefactors import (
+    compute_size_factors,
+    deconvolution_factors,
+    libsize_factors,
+)
+from consensusclustr_tpu.prep.sparse import (
+    compute_size_factors_sparse,
+    sparse_binomial_deviance,
+    sparse_deconvolution_factors,
+    sparse_libsize_factors,
+    sparse_poisson_deviance,
+    sparse_select_hvgs,
+    sparse_shifted_log,
+)
+from consensusclustr_tpu.prep.transform import shifted_log
+
+
+def _counts(n=120, g=300, seed=0, density=0.15):
+    r = np.random.default_rng(seed)
+    dense = r.poisson(0.8, size=(n, g)).astype(np.float32)
+    dense *= (r.random((n, g)) < density + 0.3).astype(np.float32)
+    # heterogeneous depth so size factors are non-trivial
+    depth = r.uniform(0.5, 2.0, size=(n, 1)).astype(np.float32)
+    dense = np.floor(dense * depth)
+    return dense
+
+
+def test_sparse_deviance_matches_dense():
+    dense = _counts()
+    csr = sp.csr_matrix(dense)
+    np.testing.assert_allclose(
+        sparse_binomial_deviance(csr), np.asarray(binomial_deviance(dense)),
+        rtol=2e-4, atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        sparse_poisson_deviance(csr), np.asarray(poisson_deviance(dense)),
+        rtol=2e-4, atol=2e-3,
+    )
+
+
+def test_sparse_hvg_selection_matches_dense():
+    dense = _counts(seed=1)
+    csr = sp.csr_matrix(dense)
+    m_sparse = sparse_select_hvgs(csr, 50)
+    m_dense = np.asarray(select_hvgs(dense, 50))
+    assert m_sparse.sum() == 50
+    # deviance ties can flip individual picks; demand near-total agreement
+    assert (m_sparse == m_dense).mean() > 0.98
+
+
+def test_sparse_size_factors_match_dense():
+    dense = _counts(seed=2)
+    csr = sp.csr_matrix(dense)
+    np.testing.assert_allclose(
+        sparse_libsize_factors(csr), np.asarray(libsize_factors(dense)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        sparse_deconvolution_factors(csr),
+        np.asarray(deconvolution_factors(dense)),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        compute_size_factors_sparse(csr, "deconvolution"),
+        np.asarray(compute_size_factors(dense, "deconvolution")),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_sparse_shifted_log_matches_dense():
+    dense = _counts(seed=3)
+    csr = sp.csr_matrix(dense)
+    sf = sparse_libsize_factors(csr)
+    out = sparse_shifted_log(csr, sf)
+    assert out.nnz == csr.nnz  # sparsity pattern preserved
+    np.testing.assert_allclose(
+        np.asarray(out.todense()),
+        np.asarray(shifted_log(dense, jnp.asarray(sf))),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_consensus_clust_sparse_equals_dense():
+    """End-to-end: scipy CSR input must give identical assignments to dense."""
+    from tests.conftest import make_blobs
+    from consensusclustr_tpu.api import consensus_clust
+
+    x, _ = make_blobs(n_per=40, n_genes=30, n_clusters=3, seed=7)
+    counts = np.floor(np.exp(x - x.min()) * 0.5)
+    kw = dict(
+        nboots=6, k_num=(8,), res_range=(0.1, 0.5), pc_num=5,
+        n_var_features=25, seed=11, alpha=1e-9,
+    )
+    dense_res = consensus_clust(counts, **kw)
+    sparse_res = consensus_clust(sp.csr_matrix(counts), **kw)
+    assert list(dense_res.assignments) == list(sparse_res.assignments)
+
+
+def test_assay_scoped_layers_take_precedence():
+    from consensusclustr_tpu.api import ClusterConfig, _ingest_anndata
+
+    class FakeAdata:
+        pass
+
+    n, g = 30, 20
+    r = np.random.default_rng(0)
+    rna = r.poisson(2.0, size=(n, g)).astype(np.float32)
+    adt = r.poisson(9.0, size=(n, g)).astype(np.float32)
+    ad = FakeAdata()
+    ad.X = rna
+    ad.obs = {}
+    ad.var = {}
+    ad.layers = {"counts": rna, "ADT_counts": adt}
+    ing = _ingest_anndata(ad, ClusterConfig(assay="ADT"))
+    np.testing.assert_array_equal(np.asarray(ing.counts), adt)
+    ing_rna = _ingest_anndata(ad, ClusterConfig())  # default assay name "RNA"
+    np.testing.assert_array_equal(np.asarray(ing_rna.counts), rna)
+
+
+def test_compute_dtype_bfloat16_runs_and_orders_neighbours():
+    from consensusclustr_tpu.cluster.knn import knn_points
+
+    r = np.random.default_rng(0)
+    x = r.normal(size=(100, 8)).astype(np.float32) * 10
+    idx32, _ = knn_points(x, 5)
+    idx16, _ = knn_points(x, 5, compute_dtype="bfloat16")
+    # bf16 rounding may flip near-ties; most neighbours must agree
+    overlap = np.mean([
+        len(set(a.tolist()) & set(b.tolist())) / 5
+        for a, b in zip(np.asarray(idx32), np.asarray(idx16))
+    ])
+    assert overlap > 0.9
+
+    with pytest.raises(ValueError):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        ClusterConfig(compute_dtype="float16")
+
+
+def test_test_splits_res_range_signature_sentinel():
+    from consensusclustr_tpu.config import TEST_SPLITS_RES_RANGE
+    from consensusclustr_tpu.nulltest.splits import test_splits
+
+    # signature sweep matches the reference's seq(0.1, 3.4, 0.15)
+    assert TEST_SPLITS_RES_RANGE[0] == pytest.approx(0.1)
+    assert TEST_SPLITS_RES_RANGE[-1] == pytest.approx(3.4)
+    assert len(TEST_SPLITS_RES_RANGE) == 23
+
+    from tests.conftest import make_blobs
+
+    x, labels = make_blobs(n_per=30, n_genes=10, n_clusters=2, sep=12.0, seed=3)
+    counts = np.floor(np.exp(x - x.min()) * 0.1)
+    # well-separated blobs: silhouette > thresh short-circuits before any null
+    # sim, so the sentinel resolution is all this exercises (fast)
+    out = test_splits(
+        counts, x, None, labels.astype(str), res_range="signature",
+        silhouette_thresh=0.05,
+    )
+    assert list(out) == list(labels.astype(str))
+    with pytest.raises(ValueError):
+        test_splits(
+            counts, x, None, labels.astype(str), res_range="bogus",
+            silhouette_thresh=0.05,
+        )

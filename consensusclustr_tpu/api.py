@@ -170,19 +170,24 @@ def _ingest_anndata(adata, cfg: ClusterConfig) -> _Ingested:
         counts = _sparse_or_dense(adata.raw.X)
     norm = None
     scale_data = False
-    scale_names = (f"{a}_scale_data", "scale_data")
-    norm_names = (f"{a}_logcounts", f"{a}_data", "logcounts", "data")
-    if any(name in layers for name in scale_names):
-        # Seurat scale.data semantics (:223-228): already HVG-subset and
-        # regressed, so _level skips both steps downstream
-        key_name = next(name for name in scale_names if name in layers)
-        norm = _densify(layers[key_name])
-        scale_data = True
-    else:
-        for name in norm_names:
-            if name in layers:
-                norm = _densify(layers[name])
-                break
+    # assay-scoped names beat ALL generic names before the scale/norm branch
+    # split, so another assay's generic scale_data cannot shadow the
+    # requested assay's own normalised layer
+    tiers = (
+        (f"{a}_scale_data", (f"{a}_logcounts", f"{a}_data")),
+        ("scale_data", ("logcounts", "data")),
+    )
+    for scale_name, norm_names in tiers:
+        if scale_name in layers:
+            # Seurat scale.data semantics (:223-228): already HVG-subset and
+            # regressed, so _level skips both steps downstream
+            norm = _densify(layers[scale_name])
+            scale_data = True
+            break
+        hit = next((nm for nm in norm_names if nm in layers), None)
+        if hit is not None:
+            norm = _sparse_or_dense(layers[hit])
+            break
     if counts is None:
         x = _densify(adata.X)
         # Heuristic mirrored from Seurat's data-vs-counts fallback (:223-231):
@@ -612,8 +617,28 @@ def consensus_clust(
     # --- output assembly at depth 1 (:580-632) ----------------------------
     dend = None
     if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
-        dist = cons.jaccard_dist if cons.jaccard_dist is not None else _euclidean(pca_used)
-        dend = determine_hierarchy(dist, labels)
+        if cons.jaccard_dist is not None:
+            dend = determine_hierarchy(cons.jaccard_dist, labels)
+        elif cons.boot_labels is not None:
+            # blockwise regime: the cell-cell matrix never existed; stream
+            # the cluster-pair mean co-clustering distances instead (:621)
+            from consensusclustr_tpu.consensus.blockwise import (
+                cocluster_cluster_distance,
+            )
+            from consensusclustr_tpu.hierarchy.dendro import (
+                _sorted_unique,
+                dendrogram_from_cluster_distance,
+            )
+
+            uniq = _sorted_unique(np.asarray(labels))
+            code_of = {u: i for i, u in enumerate(uniq)}
+            codes = np.asarray([code_of[l] for l in labels], np.int32)
+            cmat = cocluster_cluster_distance(
+                cons.boot_labels, codes, cfg.max_clusters
+            )
+            dend = dendrogram_from_cluster_distance(cmat, uniq)
+        else:
+            dend = determine_hierarchy(_euclidean(pca_used), labels)
     elif len(set(labels.tolist())) <= 1:
         log.event("failed_test")  # the reference's message("Failed Test") :613
 

@@ -55,11 +55,14 @@ def knn_points(
         n_blocks = -(-n // block)
         n_pad = n_blocks * block
         x_pad = jnp.zeros((n_pad, x.shape[1]), cd).at[:n].set(xc)
+        sq_pad = jnp.zeros((n_pad,), jnp.float32).at[:n].set(sq)
         rows_local = jnp.arange(block, dtype=jnp.int32)
 
         def one_block(b):
             xb = jax.lax.dynamic_slice(x_pad, (b * block, 0), (block, x.shape[1]))
-            sqb = jnp.sum(xb.astype(jnp.float32) ** 2, axis=1)
+            # exact f32 row norms (slicing sq keeps both branches numerically
+            # consistent under compute_dtype="bfloat16")
+            sqb = jax.lax.dynamic_slice(sq_pad, (b * block,), (block,))
             cross = jnp.einsum(
                 "id,jd->ij", xb, x_pad[:n], preferred_element_type=jnp.float32
             )

@@ -101,6 +101,12 @@ class ClusterConfig:
     # the distributed step is one fused program with no chunk boundary to
     # checkpoint at (a "checkpoint_skipped" log event records the drop).
     checkpoint_dir: Optional[str] = None
+    # Dense [n, n] consensus-matrix assembly: None = auto (dense up to
+    # 16384 cells, blockwise streaming above — consensus/blockwise.py), or
+    # force with True/False. The blockwise path computes the consensus kNN
+    # graph and merge statistics from [block, n] tiles and never holds the
+    # full matrix; its ConsensusResult carries jaccard_dist=None.
+    dense_consensus: Optional[bool] = None
     # Distributed execution: None = single chip; "auto" = shard over all
     # visible devices when >1; or an explicit jax.sharding.Mesh built by
     # parallel.mesh.consensus_mesh. The pipeline falls back to single-chip

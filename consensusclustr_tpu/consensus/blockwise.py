@@ -1,0 +1,233 @@
+"""Blockwise consensus graph: co-clustering kNN + merges without the [n, n].
+
+The consensus distance matrix is the framework's seq^2 analog (SURVEY §5
+long-context row): dense assembly is 10 GB at 50k cells and 160 GB at 200k —
+the reference sidesteps nothing here (parDist materialises the full matrix,
+R/consensusClust.R:421), so this module is where the TPU design goes beyond
+it. Strategy is the same family as blockwise attention: stream row tiles of
+the implicit distance matrix, reduce each tile immediately (running top-k for
+the consensus kNN graph; segment-sums for the cluster-pair merge statistics),
+never materialising more than one [block, n] tile.
+
+Downstream consumers and their replacements:
+  * consensus kNN -> SNN -> Leiden (reference :423-441): `blockwise_consensus_knn`
+  * small-cluster merge mean distances (:461-467): `cocluster_pair_sums` +
+    `merge_small_clusters_from_sums` (exact incremental updates — the mean
+    distance between merged clusters is a ratio of summed pair distances, so
+    the host loop updates sums/counts instead of recomputing tiles)
+  * dendrogram over final labels (:580-588): `cocluster_cluster_distance`
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Row-tile edge. [block, n] f32 at n=200k is 800 MB — the peak transient.
+BW_BLOCK = 1024
+
+
+def _onehot_chunks(labels: jax.Array, chunk: int, max_clusters: int):
+    """Pad the boot axis to `chunk` granularity and reshape to [S, chunk, n]."""
+    b, n = labels.shape
+    pad = (-b) % chunk
+    if pad:
+        labels = jnp.concatenate([labels, jnp.full((pad, n), -1, jnp.int32)], axis=0)
+    return labels.reshape(-1, chunk, n)
+
+
+def _dist_tile(
+    labels_s: jax.Array,   # [S, chunk, n] int32
+    start: jax.Array,      # scalar: first row of the tile
+    block: int,
+    max_clusters: int,
+) -> jax.Array:
+    """[block, n] co-clustering distance rows, accumulated over boot chunks."""
+    n = labels_s.shape[2]
+    cvals = jnp.arange(max_clusters, dtype=jnp.int32)
+
+    def body(carry, chunk_labels):
+        agree, union = carry
+        valid = (chunk_labels >= 0).astype(jnp.bfloat16)                  # [c, n]
+        onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)
+        onehot = onehot * valid[:, :, None]                               # [c, n, C]
+        rows = jax.lax.dynamic_slice_in_dim(onehot, start, block, axis=1)
+        vrows = jax.lax.dynamic_slice_in_dim(valid, start, block, axis=1)
+        agree = agree + jnp.einsum(
+            "cik,cjk->ij", rows, onehot, preferred_element_type=jnp.float32
+        )
+        union = union + jnp.einsum(
+            "ci,cj->ij", vrows, valid, preferred_element_type=jnp.float32
+        )
+        return (agree, union), None
+
+    # `+ start * 0` inherits start's varying-manual-axes type, so the scan
+    # carry typechecks when the tile start is a shard_map axis_index
+    zero = jnp.zeros((block, n), jnp.float32) + (start * 0).astype(jnp.float32)
+    (agree, union), _ = jax.lax.scan(body, (zero, zero), labels_s)
+    jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
+    return 1.0 - jac
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "max_clusters", "block", "chunk")
+)
+def blockwise_consensus_knn(
+    labels: jax.Array,
+    k: int,
+    max_clusters: int = 64,
+    block: int = BW_BLOCK,
+    chunk: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact co-clustering kNN graph without materialising the distance matrix.
+
+    labels: [B, n] int32 (-1 = unsampled). Returns (idx [n, k] int32, dist
+    [n, k] f32) sorted by increasing distance, self excluded. Matches
+    knn_from_distance(coclustering_distance(labels), k) exactly (same top_k
+    tie-breaking), so smaller-k graphs are prefixes of larger-k ones.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    b, n = labels.shape
+    k_eff = min(k, n - 1)
+    n_blocks = -(-n // block)
+    n_pad = n_blocks * block
+    if n_pad != n:
+        labels = jnp.concatenate(
+            [labels, jnp.full((b, n_pad - n), -1, jnp.int32)], axis=1
+        )
+    labels_s = _onehot_chunks(labels, chunk, max_clusters)
+    rows_local = jnp.arange(block, dtype=jnp.int32)
+
+    def one_block(i):
+        d = _dist_tile(labels_s, i * block, block, max_clusters)      # [block, n_pad]
+        d = d[:, :n]
+        r_global = i * block + rows_local
+        self_col = jnp.clip(r_global, 0, n - 1)
+        d = d.at[rows_local, self_col].set(jnp.inf)                   # exclude self
+        # padding rows beyond n produce garbage; sliced off by the caller
+        neg, idx = jax.lax.top_k(-d, k_eff)
+        return neg, idx
+
+    neg, idx = jax.lax.map(one_block, jnp.arange(n_blocks, dtype=jnp.int32))
+    neg = neg.reshape(n_pad, k_eff)[:n]
+    idx = idx.reshape(n_pad, k_eff)[:n]
+    if k_eff < k:
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        neg = jnp.concatenate([neg, jnp.repeat(neg[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), -neg
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_clusters", "n_clusters", "block", "chunk")
+)
+def cocluster_pair_sums(
+    labels: jax.Array,        # [B, n] int32 boot assignments
+    codes: jax.Array,         # [n] int32 cluster ids in [0, n_clusters)
+    n_clusters: int,
+    max_clusters: int = 64,
+    block: int = BW_BLOCK,
+    chunk: int = 8,
+) -> Tuple[jax.Array, jax.Array]:
+    """(sums [C, C], counts [C]): summed co-clustering distances between the
+    members of each cluster pair, streamed in [block, n] tiles.
+
+    sums / outer(counts) is cluster_mean_distance without the dense matrix
+    (self-pairs contribute distance 0 on the diagonal, matching the dense
+    path's zeroed diagonal).
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    codes = jnp.asarray(codes, jnp.int32)
+    b, n = labels.shape
+    n_blocks = -(-n // block)
+    n_pad = n_blocks * block
+    if n_pad != n:
+        labels = jnp.concatenate(
+            [labels, jnp.full((b, n_pad - n), -1, jnp.int32)], axis=1
+        )
+    labels_s = _onehot_chunks(labels, chunk, max_clusters)
+    oh_all = (codes[:, None] == jnp.arange(n_clusters)[None, :]).astype(jnp.float32)
+    codes_pad = jnp.concatenate([codes, jnp.full((n_pad - n,), -1, jnp.int32)])
+    oh_pad = (codes_pad[:, None] == jnp.arange(n_clusters)[None, :]).astype(
+        jnp.float32
+    )
+    rows_local = jnp.arange(block, dtype=jnp.int32)
+
+    def one_block(acc, i):
+        d = _dist_tile(labels_s, i * block, block, max_clusters)     # [block, n_pad]
+        d = d[:, :n]
+        r_global = i * block + rows_local
+        self_col = jnp.clip(r_global, 0, n - 1)
+        d = d.at[rows_local, self_col].set(0.0)                      # diag 0
+        ohr = jax.lax.dynamic_slice_in_dim(oh_pad, i * block, block, axis=0)
+        acc = acc + ohr.T @ (d @ oh_all)                              # [C, C]
+        return acc, None
+
+    sums, _ = jax.lax.scan(
+        one_block, jnp.zeros((n_clusters, n_clusters), jnp.float32),
+        jnp.arange(n_blocks, dtype=jnp.int32),
+    )
+    counts = jnp.sum(oh_all, axis=0)
+    return sums, counts
+
+
+def merge_small_clusters_from_sums(
+    sums: np.ndarray,
+    counts: np.ndarray,
+    labels: np.ndarray,
+    min_size: int,
+) -> np.ndarray:
+    """Small-cluster merge (reference :462-467) from pair sums.
+
+    Exact equivalent of merge_small_clusters: the mean inter-member distance
+    between merged clusters is additive in (sums, counts), so the host loop
+    updates them in place instead of re-streaming tiles.
+    """
+    labels = np.asarray(labels, np.int32).copy()
+    sums = np.asarray(sums, np.float64).copy()
+    counts = np.asarray(counts, np.float64).copy()
+    while True:
+        live = np.where(counts > 0)[0]
+        if len(live) <= 1:
+            return labels
+        smallest = live[np.argmin(counts[live])]
+        if counts[live].min() >= min_size:
+            return labels
+        with np.errstate(invalid="ignore", divide="ignore"):
+            denom = counts[smallest] * counts
+            row = np.where(denom > 0, sums[smallest] / np.maximum(denom, 1.0), np.inf)
+        row[smallest] = np.inf
+        row[counts <= 0] = np.inf
+        target = int(np.argmin(row))
+        labels[labels == smallest] = target
+        # fold row then column: the diagonal picks up all four terms
+        sums[target, :] += sums[smallest, :]
+        sums[:, target] += sums[:, smallest]
+        sums[smallest, :] = 0.0
+        sums[:, smallest] = 0.0
+        counts[target] += counts[smallest]
+        counts[smallest] = 0.0
+
+
+def cocluster_cluster_distance(
+    boot_labels: np.ndarray, codes: np.ndarray, max_clusters: int = 64
+) -> np.ndarray:
+    """[C, C] mean co-clustering distance between final clusters, streamed —
+    the determineHierachy(return="distance") input for the dendrogram when the
+    dense matrix was never assembled (reference :621)."""
+    codes = np.asarray(codes, np.int32)
+    n_clusters = int(codes.max()) + 1
+    sums, counts = cocluster_pair_sums(
+        jnp.asarray(boot_labels, jnp.int32), jnp.asarray(codes), n_clusters,
+        max_clusters,
+    )
+    sums = np.asarray(sums, np.float64)
+    counts = np.asarray(counts, np.float64)
+    denom = np.outer(counts, counts)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = np.where(denom > 0, sums / np.maximum(denom, 1.0), np.inf)
+    return out

@@ -50,10 +50,14 @@ from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
 
 
+DENSE_CONSENSUS_LIMIT = 16384  # cells; above this the blockwise path is auto
+
+
 class ConsensusResult(NamedTuple):
     labels: np.ndarray                 # [n] compact consensus labels
     silhouette: float                  # mean approx-silhouette of labels on PCA
-    jaccard_dist: Optional[np.ndarray]  # [n, n] co-clustering distance (None if nboots<=1)
+    jaccard_dist: Optional[np.ndarray]  # [n, n] co-clustering distance (None if
+    #                                     nboots<=1 OR the blockwise path ran)
     boot_labels: Optional[np.ndarray]   # [B(,*K*R), n] aligned boot assignments
     n_clusters: int
 
@@ -117,14 +121,16 @@ def _auto_boot_chunk(
     e = 2 * k_max
     knn_bytes = (m * m if m <= 2 * KNN_BLOCK else KNN_BLOCK * m) * 4.0
     per_boot = knn_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
-    on_cpu = jax.default_backend() == "cpu"
+    backend = jax.default_backend()
+    on_cpu = backend == "cpu"
     budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9 if on_cpu else 6e9))
     # TPU cap: XLA compile time grows superlinearly with the vmapped boot
     # axis, and the serving tunnel kills calls that stall past ~2 min — a
     # chunk of 8 compiles in ~70 s and is also the warm-throughput sweet spot
     # (larger chunks LOWER boots/sec; measured on v5e). CCTPU_MAX_CHUNK
-    # overrides for untunneled pods.
-    cap = int(os.environ.get("CCTPU_MAX_CHUNK", 64 if on_cpu else 8))
+    # overrides for untunneled pods. The cap is TPU-specific — other
+    # accelerators keep the budget-derived chunk.
+    cap = int(os.environ.get("CCTPU_MAX_CHUNK", 8 if backend == "tpu" else 64))
     return int(max(1, min(nboots, budget // max(per_boot, 1.0), cap)))
 
 
@@ -210,9 +216,9 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
 @functools.partial(
     jax.jit, static_argnames=("k_list", "max_clusters", "n_iters", "cluster_fun")
 )
-def _consensus_grid(
+def _consensus_grid_from_knn(
     key: jax.Array,
-    dist: jax.Array,     # [n, n] jaccard distance
+    knn_idx: jax.Array,  # [n, max(k_list)] kNN of the consensus distance
     pca: jax.Array,      # [n, d] for silhouette ranking
     res_list: jax.Array,
     k_list,
@@ -220,14 +226,16 @@ def _consensus_grid(
     n_iters: int = 20,
     cluster_fun: str = "leiden",
 ):
-    """Consensus re-clustering (reference :423-441): kNN on the distance
-    matrix per k, SNN, Leiden per resolution; rank by PCA silhouette with the
-    all-singletons -> -1 floor (:445-453)."""
+    """Consensus re-clustering (reference :423-441) from a precomputed kNN
+    graph: SNN + Leiden per (k, resolution); rank by PCA silhouette with the
+    all-singletons -> -1 floor (:445-453). Smaller-k graphs are prefixes of
+    the max-k one (top_k order is deterministic), so one kNN pass serves the
+    whole k sweep — and the dense and blockwise paths share this function,
+    which makes them select identical candidates."""
     r = res_list.shape[0]
     all_labels, all_scores = [], []
     for ki, k in enumerate(k_list):
-        idx, _ = knn_from_distance(dist, k)
-        graph = snn_graph(idx)
+        graph = snn_graph(knn_idx[:, :k])
         keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r))
 
         def one_res(kk, res):
@@ -246,6 +254,23 @@ def _consensus_grid(
     # first occurrence — the opposite of the boot path's "first"/last pairing.
     best = jnp.argmax(scores)
     return labels[best], scores
+
+
+def _consensus_grid(
+    key: jax.Array,
+    dist: jax.Array,     # [n, n] jaccard distance
+    pca: jax.Array,
+    res_list: jax.Array,
+    k_list,
+    max_clusters: int,
+    n_iters: int = 20,
+    cluster_fun: str = "leiden",
+):
+    """Dense-matrix entry: one kNN pass at max k, then the shared grid."""
+    idx, _ = knn_from_distance(dist, max(k_list))
+    return _consensus_grid_from_knn(
+        key, idx, pca, res_list, k_list, max_clusters, n_iters, cluster_fun
+    )
 
 
 def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
@@ -296,18 +321,35 @@ def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
 def _finish_consensus(
     pca: jax.Array,
     labels: np.ndarray,
-    dist_np: np.ndarray,
+    dist_np: Optional[np.ndarray],
     boot_labels: np.ndarray,
     cfg: ClusterConfig,
     k_list,
     log: Optional[LevelLog],
 ) -> ConsensusResult:
     """Shared tail of the bootstrap paths: small-cluster merge (:461-467),
-    stability merge (:469-497), final silhouette."""
-    # small-cluster merge on co-clustering distances (:461-467)
-    labels = merge_small_clusters(
-        dist_np, labels, max(k_list[0], 20), cfg.max_clusters
-    )
+    stability merge (:469-497), final silhouette.
+
+    dist_np=None is the blockwise regime: the small-cluster merge runs on
+    streamed cluster-pair sums instead of the dense matrix."""
+    if dist_np is not None:
+        # small-cluster merge on co-clustering distances (:461-467)
+        labels = merge_small_clusters(
+            dist_np, labels, max(k_list[0], 20), cfg.max_clusters
+        )
+    else:
+        from consensusclustr_tpu.consensus.blockwise import (
+            cocluster_pair_sums,
+            merge_small_clusters_from_sums,
+        )
+
+        sums, counts = cocluster_pair_sums(
+            jnp.asarray(boot_labels, jnp.int32), jnp.asarray(labels, jnp.int32),
+            cfg.max_clusters, cfg.max_clusters,
+        )
+        labels = merge_small_clusters_from_sums(
+            np.asarray(sums), np.asarray(counts), labels, max(k_list[0], 20)
+        )
     # stability merge against the per-boot assignments (:469-497)
     labels = merge_unstable_clusters(
         labels, boot_labels, cfg.min_stability, cfg.max_clusters
@@ -339,6 +381,7 @@ def consensus_cluster(
 
     mesh = _resolve_mesh(cfg, n, log)
     if mesh is not None:
+        from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
         from consensusclustr_tpu.parallel.step import (
             distributed_consensus_cluster,
         )
@@ -347,8 +390,11 @@ def consensus_cluster(
             # the fused sharded step has no per-chunk boundary to persist at;
             # surface the contract change instead of silently dropping it
             log.event("checkpoint_skipped", reason="distributed step is fused")
+        dense = cfg.dense_consensus
+        if dense is None:
+            dense = n <= DENSE_CONSENSUS_LIMIT
         labels_np, dist_np, boot_labels = distributed_consensus_cluster(
-            key, pca, cfg, mesh
+            key, pca, cfg, mesh, dense=dense
         )
         if log:
             log.event(
@@ -387,19 +433,37 @@ def consensus_cluster(
         )
 
     boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log)
-    dist = coclustering_distance(
-        jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
-        use_pallas=cfg.use_pallas,
-    )
-    cons_labels, cons_scores = _consensus_grid(
-        key, dist, pca, res_list, k_list, cfg.max_clusters,
-        cluster_fun=cfg.cluster_fun,
-    )
+    dense = cfg.dense_consensus
+    if dense is None:
+        dense = n <= DENSE_CONSENSUS_LIMIT
+    if dense:
+        dist = coclustering_distance(
+            jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
+            use_pallas=cfg.use_pallas,
+        )
+        cons_labels, cons_scores = _consensus_grid(
+            key, dist, pca, res_list, k_list, cfg.max_clusters,
+            cluster_fun=cfg.cluster_fun,
+        )
+        dist_np = np.asarray(dist)
+    else:
+        from consensusclustr_tpu.consensus.blockwise import (
+            blockwise_consensus_knn,
+        )
+
+        knn_idx, _ = blockwise_consensus_knn(
+            jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters
+        )
+        cons_labels, cons_scores = _consensus_grid_from_knn(
+            key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
+            cluster_fun=cfg.cluster_fun,
+        )
+        dist_np = None
     labels = np.asarray(cons_labels)
-    dist_np = np.asarray(dist)
     if log:
         log.event(
             "consensus", n_clusters=len(np.unique(labels)),
             best_score=float(np.max(np.asarray(cons_scores))),
+            dense=bool(dense),
         )
     return _finish_consensus(pca, labels, dist_np, boot_labels, cfg, k_list, log)

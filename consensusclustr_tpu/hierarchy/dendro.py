@@ -126,6 +126,21 @@ class Dendrogram:
         return self.linkage[self.linkage[:, 2] <= height, 2]
 
 
+def dendrogram_from_cluster_distance(
+    cmat: np.ndarray, labels: Sequence
+) -> Dendrogram:
+    """Dendrogram straight from a precomputed [C, C] mean-distance matrix —
+    the blockwise-consensus path, where no cell-cell matrix was ever
+    assembled (consensus/blockwise.py cocluster_cluster_distance)."""
+    labels = list(labels)
+    if len(labels) <= 1:
+        return Dendrogram(linkage=np.zeros((0, 4)), labels=labels)
+    cm = np.asarray(cmat, np.float64).copy()
+    np.fill_diagonal(cm, 0.0)
+    z = sch.linkage(squareform(cm, checks=False), method="complete")
+    return Dendrogram(linkage=z, labels=labels)
+
+
 def determine_hierarchy(
     distance_matrix: np.ndarray,
     assignments: Sequence,

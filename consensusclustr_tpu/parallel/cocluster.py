@@ -73,6 +73,83 @@ def _partial_counts(
     return agree, union
 
 
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "k", "max_clusters", "block", "chunk")
+)
+def sharded_blockwise_consensus_knn(
+    labels: jax.Array,
+    mesh: jax.sharding.Mesh,
+    k: int,
+    max_clusters: int = 64,
+    block: int = 512,
+    chunk: int = 8,
+):
+    """Sharded co-clustering kNN without a dense [n, n] anywhere — the scale
+    regime (BASELINE configs 3-5) where even the row-sharded matrix of
+    `sharded_coclustering_distance` cannot be held (200k cells: 20 GB per
+    device on an 8-mesh).
+
+    Rows are sharded over the FLATTENED ("boot", "cell") mesh — every device
+    owns n/D rows and streams [block, n] distance tiles from the replicated
+    boot labels (consensus/blockwise.py tile kernel) past a local top-k.
+    Returns (idx [n, k], dist [n, k]) sharded over the flattened axes; the
+    small [n, k] graph is then cheap to replicate. Requires n % D == 0.
+    """
+    from consensusclustr_tpu.consensus.blockwise import (
+        _dist_tile,
+        _onehot_chunks,
+    )
+
+    b, n = labels.shape
+    n_dev = mesh.shape[BOOT_AXIS] * mesh.shape[CELL_AXIS]
+    # pad the cell axis to the device count with all -1 columns: padded cells
+    # sit at distance 1 from everything and always lose top_k ties to real
+    # cells (earliest-index tie-break), so they never contaminate real rows
+    n_pad = -(-n // n_dev) * n_dev
+    if n_pad != n:
+        labels = jnp.concatenate(
+            [jnp.asarray(labels, jnp.int32),
+             jnp.full((b, n_pad - n), -1, jnp.int32)], axis=1
+        )
+    n_rows = n_pad // n_dev
+    k_eff = min(k, n - 1)
+    blk = min(block, n_rows)
+    while n_rows % blk:  # largest divisor of the per-device rows <= block
+        blk -= 1
+
+    def kernel(labels_rep):
+        i_boot = jax.lax.axis_index(BOOT_AXIS)
+        i_cell = jax.lax.axis_index(CELL_AXIS)
+        dev = i_boot * mesh.shape[CELL_AXIS] + i_cell
+        row0 = (dev * n_rows).astype(jnp.int32)
+        labels_s = _onehot_chunks(labels_rep, chunk, max_clusters)
+        rows_local = jnp.arange(blk, dtype=jnp.int32)
+
+        def one_block(i):
+            start = row0 + i * blk
+            d = _dist_tile(labels_s, start, blk, max_clusters)   # [blk, n_pad]
+            self_col = jnp.clip(start + rows_local, 0, n_pad - 1)
+            d = d.at[rows_local, self_col].set(jnp.inf)
+            return jax.lax.top_k(-d, k_eff)
+
+        neg, idx = jax.lax.map(one_block, jnp.arange(n_rows // blk, dtype=jnp.int32))
+        return idx.reshape(n_rows, k_eff), -neg.reshape(n_rows, k_eff)
+
+    both = (BOOT_AXIS, CELL_AXIS)
+    idx, dist = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=P(None, None),
+        out_specs=(P(both, None), P(both, None)),
+    )(jnp.asarray(labels, jnp.int32))
+    idx, dist = idx[:n], dist[:n]
+    if k_eff < k:
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        dist = jnp.concatenate([dist, jnp.repeat(dist[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), dist
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "max_clusters", "chunk"))
 def sharded_coclustering_distance(
     labels: jax.Array,

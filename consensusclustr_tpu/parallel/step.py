@@ -39,7 +39,10 @@ from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
 from consensusclustr_tpu.parallel.boots import sharded_run_bootstraps
-from consensusclustr_tpu.parallel.cocluster import sharded_coclustering_distance
+from consensusclustr_tpu.parallel.cocluster import (
+    sharded_blockwise_consensus_knn,
+    sharded_coclustering_distance,
+)
 from consensusclustr_tpu.parallel.knn import sharded_knn_from_distance
 from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 from consensusclustr_tpu.utils.rng import cluster_key
@@ -92,7 +95,8 @@ def _consensus_grid_sharded(
 class DistributedStepResult(NamedTuple):
     labels: jax.Array       # [n] best consensus candidate (replicated)
     scores: jax.Array       # [K*R_pad] candidate scores (-inf at padding)
-    dist: jax.Array         # [n, n] co-clustering distance (row-sharded)
+    dist: Optional[jax.Array]  # [n, n] co-clustering distance (row-sharded);
+    #                            None in the blockwise (dense=False) regime
     boot_labels: jax.Array  # [B_pad, n] aligned boot assignments (boot-sharded)
 
 
@@ -100,7 +104,7 @@ class DistributedStepResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun",
-        "compute_dtype",
+        "compute_dtype", "dense",
     ),
 )
 def distributed_consensus_step(
@@ -117,6 +121,7 @@ def distributed_consensus_step(
     n_iters: int = 20,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
+    dense: bool = True,
 ) -> DistributedStepResult:
     n, _ = pca.shape
     b_pad = idx.shape[0]
@@ -131,12 +136,22 @@ def distributed_consensus_step(
     boot_labels = jnp.where(
         (jnp.arange(b_pad) < n_real_boots)[:, None], boot_labels, -1
     )
-    dist = sharded_coclustering_distance(boot_labels, mesh, max_clusters)
+    if dense:
+        dist = sharded_coclustering_distance(boot_labels, mesh, max_clusters)
+        knn_all, _ = sharded_knn_from_distance(dist, mesh, max(k_list))
+    else:
+        # scale regime: no [n, n] anywhere — rows stream past a local top-k
+        dist = None
+        knn_all, _ = sharded_blockwise_consensus_knn(
+            boot_labels, mesh, max(k_list), max_clusters
+        )
 
     all_labels, all_scores = [], []
     r_pad = res_list.shape[0]
     for ki, k in enumerate(k_list):
-        knn_idx, _ = sharded_knn_from_distance(dist, mesh, k)
+        # smaller-k graphs are prefixes of the max-k one (deterministic
+        # top_k order), mirroring the single-chip _consensus_grid_from_knn
+        knn_idx = knn_all[:, :k]
         # same RNG tags as the single-chip _consensus_grid (pipeline.py)
         gkeys = jax.vmap(
             lambda t: cluster_key(key, 90_000 + ki * 1000 + t)
@@ -161,6 +176,7 @@ def distributed_consensus_cluster(
     cfg: ClusterConfig,
     mesh: jax.sharding.Mesh,
     return_dist: bool = True,
+    dense: bool = True,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
     """Host wrapper: pad the boot and resolution axes to the mesh, run the
     fused step, return (labels [n], dist [n, n] or None, boot_labels [B, n])
@@ -192,9 +208,10 @@ def distributed_consensus_cluster(
         key, pca, idx, res_arr, res_mask, jnp.int32(cfg.nboots), mesh,
         tuple(int(k) for k in cfg.k_num), cfg.max_clusters, r_real,
         cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
+        dense=dense,
     )
     return (
         np.asarray(out.labels),
-        np.asarray(out.dist) if return_dist else None,
+        np.asarray(out.dist) if (return_dist and out.dist is not None) else None,
         np.asarray(out.boot_labels[: cfg.nboots]),
     )

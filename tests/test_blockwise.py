@@ -1,0 +1,202 @@
+"""Blockwise consensus graph: parity with the dense path + the scale regime.
+
+VERDICT r2 task 5: build the consensus kNN from co-clustering tiles without
+materialising [n, n]; 200k-cell synthetic with dense assembly disabled,
+bounded memory.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from consensusclustr_tpu.consensus.blockwise import (
+    blockwise_consensus_knn,
+    cocluster_cluster_distance,
+    cocluster_pair_sums,
+    merge_small_clusters_from_sums,
+)
+from consensusclustr_tpu.consensus.cocluster import _einsum_coclustering_distance
+from consensusclustr_tpu.consensus.merge import merge_small_clusters
+from consensusclustr_tpu.cluster.knn import knn_from_distance
+
+
+def _boot_labels(n=700, b=12, c=5, noise=0.2, seed=0):
+    """Synthetic boot assignments with planted co-clustering structure."""
+    r = np.random.default_rng(seed)
+    truth = r.integers(0, c, size=n)
+    out = np.empty((b, n), np.int32)
+    for i in range(b):
+        lab = truth.copy()
+        flip = r.random(n) < noise
+        lab[flip] = r.integers(0, c, size=flip.sum())
+        lab[r.random(n) < 0.1] = -1  # unsampled
+        out[i] = lab
+    return out, truth
+
+
+def test_blockwise_knn_matches_dense():
+    labels, _ = _boot_labels()
+    dist = np.asarray(_einsum_coclustering_distance(jnp.asarray(labels), 8))
+    want_idx, want_d = knn_from_distance(jnp.asarray(dist), 10)
+    got_idx, got_d = blockwise_consensus_knn(
+        jnp.asarray(labels), 10, max_clusters=8, block=256
+    )
+    np.testing.assert_allclose(np.asarray(got_d), np.asarray(want_d), atol=1e-5)
+    # distances tie heavily (quantised Jaccard), so compare neighbour SETS at
+    # equal distance rather than exact ids
+    gd, wd = np.asarray(got_d), np.asarray(want_d)
+    gi, wi = np.asarray(got_idx), np.asarray(want_idx)
+    exact = (gi == wi).mean()
+    assert exact > 0.9, exact
+    # where ids differ the distances must still agree (tie swaps only)
+    np.testing.assert_allclose(gd[gi != wi], wd[gi != wi], atol=1e-5)
+
+
+def test_blockwise_knn_prefix_property():
+    labels, _ = _boot_labels(seed=1)
+    idx_max, _ = blockwise_consensus_knn(jnp.asarray(labels), 15, max_clusters=8)
+    idx_5, _ = blockwise_consensus_knn(jnp.asarray(labels), 5, max_clusters=8)
+    np.testing.assert_array_equal(np.asarray(idx_max)[:, :5], np.asarray(idx_5))
+
+
+def test_pair_sums_match_dense_segment_sums():
+    labels, truth = _boot_labels(n=300, seed=2)
+    codes = truth.astype(np.int32)
+    c = int(codes.max()) + 1
+    dist = np.asarray(_einsum_coclustering_distance(jnp.asarray(labels), 8))
+    oh = (codes[:, None] == np.arange(c)[None, :]).astype(np.float64)
+    want = oh.T @ dist @ oh
+    sums, counts = cocluster_pair_sums(
+        jnp.asarray(labels), jnp.asarray(codes), c, 8, block=128
+    )
+    np.testing.assert_allclose(np.asarray(sums), want, rtol=1e-4, atol=1e-2)
+    np.testing.assert_array_equal(np.asarray(counts), oh.sum(0))
+
+
+def test_merge_from_sums_matches_dense_merge():
+    labels, truth = _boot_labels(n=400, c=6, seed=3)
+    # unbalance the clusters so small ones exist
+    codes = truth.astype(np.int32)
+    codes[codes == 5] = np.where(np.arange((codes == 5).sum()) < 8, 5, 0)
+    dist = np.asarray(_einsum_coclustering_distance(jnp.asarray(labels), 8))
+    dense = merge_small_clusters(dist, codes, 30, 16)
+    sums, counts = cocluster_pair_sums(
+        jnp.asarray(labels), jnp.asarray(codes), 16, 8
+    )
+    sparse = merge_small_clusters_from_sums(
+        np.asarray(sums), np.asarray(counts), codes, 30
+    )
+    np.testing.assert_array_equal(dense, sparse)
+
+
+def test_cluster_distance_recovers_structure():
+    labels, truth = _boot_labels(n=500, c=4, noise=0.1, seed=4)
+    cmat = cocluster_cluster_distance(labels, truth.astype(np.int32), 8)
+    off = cmat[~np.eye(4, dtype=bool)]
+    diag = np.diag(cmat)
+    assert diag.max() < off.min(), (diag, off)
+
+
+def test_consensus_clust_blockwise_equals_dense():
+    """Forcing dense_consensus=False must reproduce the dense path's
+    assignments (same RNG tags, same kNN graph by the prefix property)."""
+    from tests.conftest import make_blobs
+    from consensusclustr_tpu.api import consensus_clust
+
+    x, _ = make_blobs(n_per=50, n_genes=30, n_clusters=3, seed=9)
+    counts = np.floor(np.exp(x - x.min()) * 0.5)
+    kw = dict(
+        nboots=6, k_num=(8, 12), res_range=(0.1, 0.5), pc_num=5,
+        n_var_features=25, seed=11, alpha=1e-9,
+    )
+    a = consensus_clust(counts, dense_consensus=True, **kw)
+    b = consensus_clust(counts, dense_consensus=False, **kw)
+    assert list(a.assignments) == list(b.assignments)
+    # blockwise still produces a dendrogram (streamed cluster distances)
+    if a.cluster_dendrogram is not None:
+        assert b.cluster_dendrogram is not None
+        np.testing.assert_allclose(
+            a.cluster_dendrogram.linkage[:, 2],
+            b.cluster_dendrogram.linkage[:, 2],
+            atol=1e-4,
+        )
+
+
+def test_sharded_blockwise_knn_matches_single_chip():
+    from consensusclustr_tpu.parallel.cocluster import (
+        sharded_blockwise_consensus_knn,
+    )
+    from consensusclustr_tpu.parallel.mesh import consensus_mesh
+
+    labels, _ = _boot_labels(n=640, seed=5)
+    mesh = consensus_mesh(boot=4, cell=2)
+    idx_s, d_s = sharded_blockwise_consensus_knn(
+        jnp.asarray(labels), mesh, 10, max_clusters=8
+    )
+    idx_1, d_1 = blockwise_consensus_knn(jnp.asarray(labels), 10, max_clusters=8)
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_1), atol=1e-5)
+    same = (np.asarray(idx_s) == np.asarray(idx_1)).mean()
+    assert same > 0.9, same
+
+
+def test_distributed_step_dense_false_matches_dense_labels():
+    from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.parallel.mesh import consensus_mesh
+    from consensusclustr_tpu.parallel.step import distributed_consensus_cluster
+    from consensusclustr_tpu.utils.rng import root_key
+    from tests.conftest import make_blobs
+
+    x, _ = make_blobs(n_per=32, n_genes=16, n_clusters=2, seed=6)
+    pca = x[:, :4].astype(np.float32)  # n = 64, divisible by 8 devices
+    cfg = ClusterConfig(nboots=8, k_num=(5,), res_range=(0.1, 0.5), max_clusters=16)
+    key = root_key(7)
+    mesh = consensus_mesh(boot=4, cell=2)
+    la, dist_a, _ = distributed_consensus_cluster(key, pca, cfg, mesh, dense=True)
+    lb, dist_b, _ = distributed_consensus_cluster(key, pca, cfg, mesh, dense=False)
+    assert dist_b is None and dist_a is not None
+    np.testing.assert_array_equal(la, lb)
+
+
+@pytest.mark.slow
+def test_scale_200k_blockwise_bounded_memory():
+    """200k cells on the 8-device CPU mesh with dense assembly disabled
+    (VERDICT r2 task 5 done-criterion). The dense matrix would be 160 GB;
+    the blockwise pass peaks at one [block, n] tile per device (~400 MB
+    total) and must recover the planted co-clustering neighbourhoods."""
+    from consensusclustr_tpu.parallel.cocluster import (
+        sharded_blockwise_consensus_knn,
+    )
+    from consensusclustr_tpu.parallel.mesh import consensus_mesh
+
+    n, b, c = 200_000, 4, 4
+    labels, truth = _boot_labels(n=n, b=b, c=c, noise=0.1, seed=8)
+    mesh = consensus_mesh(boot=4, cell=2)
+    idx, dist = sharded_blockwise_consensus_knn(
+        jnp.asarray(labels), mesh, 5, max_clusters=c, block=256, chunk=4
+    )
+    idx = np.asarray(idx)
+    assert idx.shape == (n, 5)
+    # neighbours should share the planted group overwhelmingly
+    sample = np.random.default_rng(0).integers(0, n, size=2000)
+    agree = (truth[idx[sample]] == truth[sample][:, None]).mean()
+    assert agree > 0.95, agree
+
+
+def test_sharded_blockwise_knn_pads_indivisible_n():
+    """n not divisible by the device count pads with -1 cells that never
+    contaminate real rows (they lose all top_k ties)."""
+    from consensusclustr_tpu.parallel.cocluster import (
+        sharded_blockwise_consensus_knn,
+    )
+    from consensusclustr_tpu.parallel.mesh import consensus_mesh
+
+    labels, _ = _boot_labels(n=650, seed=10)  # 650 % 8 != 0
+    mesh = consensus_mesh(boot=4, cell=2)
+    idx_s, d_s = sharded_blockwise_consensus_knn(
+        jnp.asarray(labels), mesh, 10, max_clusters=8
+    )
+    idx_1, d_1 = blockwise_consensus_knn(jnp.asarray(labels), 10, max_clusters=8)
+    assert idx_s.shape == (650, 10)
+    assert int(np.asarray(idx_s).max()) < 650  # no padded ids leak
+    np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_1), atol=1e-5)

@@ -170,3 +170,29 @@ def test_test_splits_res_range_signature_sentinel():
             counts, x, None, labels.astype(str), res_range="bogus",
             silhouette_thresh=0.05,
         )
+
+
+def test_assay_scoped_norm_beats_generic_scale_data():
+    """Another assay's generic scale_data must not shadow the requested
+    assay's own normalised layer."""
+    from consensusclustr_tpu.api import ClusterConfig, _ingest_anndata
+
+    class FakeAdata:
+        pass
+
+    n, g = 20, 10
+    r = np.random.default_rng(1)
+    rna_scaled = r.normal(size=(n, g)).astype(np.float32)
+    adt_norm = r.random((n, g)).astype(np.float32)
+    ad = FakeAdata()
+    ad.X = np.zeros((n, g), np.float32)
+    ad.obs = {}
+    ad.var = {}
+    ad.layers = {"scale_data": rna_scaled, "ADT_data": adt_norm}
+    ing = _ingest_anndata(ad, ClusterConfig(assay="ADT"))
+    assert not ing.scale_data
+    np.testing.assert_array_equal(np.asarray(ing.norm_counts), adt_norm)
+    # default assay falls back to the generic scale_data tier
+    ing_rna = _ingest_anndata(ad, ClusterConfig())
+    assert ing_rna.scale_data
+    np.testing.assert_array_equal(np.asarray(ing_rna.norm_counts), rna_scaled)

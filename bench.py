@@ -41,6 +41,45 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _run_pbmc3k() -> dict:
+    """BASELINE config 1: pbmc3k-shaped NB fixture (2,700 cells, realistic
+    sparsity + depth variation), 100 bootstraps, pcNum=5, Leiden, full
+    consensus_clust end to end. Select with BENCH_CONFIG=pbmc3k."""
+    import time as _time
+
+    import jax
+
+    from consensusclustr_tpu.api import consensus_clust
+    from consensusclustr_tpu.utils.compile_cache import enable_persistent_cache
+    from consensusclustr_tpu.utils.synth import nb_mixture_counts
+
+    enable_persistent_cache()
+    nboots = int(os.environ.get("BENCH_BOOTS", 100))
+    counts, truth = nb_mixture_counts(seed=42)
+    t0 = _time.perf_counter()
+    res = consensus_clust(counts, nboots=nboots, pc_num=5, seed=1)
+    dt = _time.perf_counter() - t0
+
+    codes = np.unique(res.assignments, return_inverse=True)[1]
+    n_pops = len(np.unique(truth))
+    ct = np.zeros((n_pops, codes.max() + 1))
+    np.add.at(ct, (truth, codes), 1)
+    comb = lambda v: v * (v - 1) / 2.0  # noqa: E731
+    s_ij = comb(ct).sum(); s_a = comb(ct.sum(1)).sum(); s_b = comb(ct.sum(0)).sum()
+    tot = comb(len(codes)); exp = s_a * s_b / tot; mx = 0.5 * (s_a + s_b)
+    ari = float((s_ij - exp) / (mx - exp)) if mx != exp else 1.0
+    return {
+        "metric": f"pbmc3k e2e wall ({nboots} boots, pcNum=5)",
+        "value": round(dt, 2),
+        "unit": "s",
+        "vs_baseline": round((nboots / dt) / NORTH_STAR_BOOTS_PER_SEC, 3),
+        "backend": jax.default_backend(),
+        "n_clusters": int(res.n_clusters),
+        "ari_vs_truth": round(ari, 4),
+        "boots_per_sec": round(nboots / dt, 3),
+    }
+
+
 def _run() -> dict:
     import jax
     import jax.numpy as jnp
@@ -48,6 +87,9 @@ def _run() -> dict:
     from consensusclustr_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()
+
+    if os.environ.get("BENCH_CONFIG") == "pbmc3k":
+        return _run_pbmc3k()
 
     from consensusclustr_tpu import consensus as _  # noqa: F401  (import check)
     from consensusclustr_tpu.config import ClusterConfig
@@ -91,6 +133,23 @@ def _run() -> dict:
     dt = time.perf_counter() - t0
     boots_per_sec = nboots / dt
 
+    # On-accelerator parity artifact: the dispatched kernel (Pallas on TPU)
+    # against the einsum oracle on a small labels sample.
+    parity = None
+    try:
+        from consensusclustr_tpu.consensus.cocluster import (
+            _einsum_coclustering_distance,
+        )
+
+        lab = jnp.asarray(
+            rng.integers(-1, 8, size=(32, 512)).astype(np.int32)
+        )
+        d_dispatch = coclustering_distance(lab, 64, use_pallas=cfg.use_pallas)
+        d_oracle = _einsum_coclustering_distance(lab, 64)
+        parity = float(jnp.max(jnp.abs(d_dispatch - d_oracle)))
+    except Exception:
+        pass
+
     return {
         "metric": f"bootstraps/sec ({n} cells, {n_res} res, k=3, to consensus matrix)",
         "value": round(boots_per_sec, 3),
@@ -98,6 +157,7 @@ def _run() -> dict:
         "vs_baseline": round(boots_per_sec / NORTH_STAR_BOOTS_PER_SEC, 3),
         "backend": backend,
         "path": cocluster_mod.LAST_PATH,
+        "pallas_parity_max_diff": parity,
         "cells": n,
         "boots": nboots,
         "wall_s": round(dt, 3),
@@ -122,11 +182,16 @@ def main() -> None:
                 del env[k]
         import subprocess
 
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            stdout=subprocess.PIPE, text=True,
-        )
-        out = proc.stdout.strip().splitlines()
+        try:
+            # bounded: with a wedged serving tunnel, interpreter start itself
+            # can hang in the PJRT registration hook — never wait forever
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.PIPE, text=True, timeout=1800,
+            )
+            out = proc.stdout.strip().splitlines()
+        except subprocess.TimeoutExpired:
+            out = []
         if out:
             print(out[-1], flush=True)
             return

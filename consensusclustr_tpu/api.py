@@ -484,6 +484,11 @@ def _level(
                 key=cluster_key(key, "pca"),
                 counts=(jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None),
                 size_factors=sf,
+                design=(
+                    jnp.asarray(ing.covariates, jnp.float32)
+                    if ing.covariates is not None
+                    else None
+                ),
             )
             pca = np.asarray(scores)
         except Exception as e:  # PCA failure => single cluster (:368-379)
@@ -492,6 +497,22 @@ def _level(
         if not np.all(np.isfinite(pca)):
             log.event("pca_failed", error="non-finite scores")
             return _single_cluster(n), None, None
+    # Shape bucketing of the PC axis (SURVEY §7.3 item 2): pad to a multiple
+    # of 4 with zero columns — inert for every distance/silhouette downstream
+    # (exact), but subproblems with nearby elbow choices share jit caches.
+    # pc_num itself stays UNpadded: the null sims extract pc_num genuine PCs
+    # from simulated data, so feeding them the padded width would compare an
+    # effectively lower-dimensional observed statistic against a higher-
+    # dimensional null — anti-conservative. Only the boot grid (the hot
+    # path) sees the bucketed width.
+    if cfg.shape_buckets and depth > 1:
+        d_pad = -(-int(pc_num) // 4) * 4
+        pca = np.asarray(pca, np.float32)
+        if d_pad != pca.shape[1]:
+            pca = np.concatenate(
+                [pca, np.zeros((pca.shape[0], d_pad - pca.shape[1]), np.float32)],
+                axis=1,
+            )
     log.event("pca", pc_num=int(pc_num))
 
     # --- consensus clustering (L5, :388-511) ------------------------------
@@ -519,6 +540,19 @@ def _level(
             labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))
     return labels, cons, pca
+
+
+_BUCKET_BASE = 64
+_BUCKET_RATIO = 1.3
+
+
+def _bucket_size(n: int) -> int:
+    """Smallest size in the geometric bucket series >= n (SURVEY §7.3 item 2:
+    pad-to-bucket sizing bounds XLA recompilation across iterate levels)."""
+    s = _BUCKET_BASE
+    while s < n:
+        s = int(np.ceil(s * _BUCKET_RATIO))
+    return s
 
 
 def _relabel(labels: np.ndarray) -> np.ndarray:
@@ -554,16 +588,31 @@ def _iterate(
         if n_c <= cfg.min_size:
             continue
         sub_cfg = cfg.replace(variable_features=None, depth=depth + 1)
+        # Shape bucketing (SURVEY §7.3 item 2): pad the subproblem's cell
+        # count to the geometric bucket by cyclic duplication — the same
+        # with-replacement duplication the bootstrap already performs, so
+        # every downstream kernel handles it natively — and slice the child
+        # labels back. Same-bucket subclusters then share every jit cache.
+        if cfg.shape_buckets:
+            n_pad = _bucket_size(n_c)
+            pad_idx = np.arange(n_pad) % n_c
+        else:
+            pad_idx = np.arange(n_c)
+        sub_counts = counts[mask][pad_idx]
+        sub_cov = (
+            covariates[mask][pad_idx] if covariates is not None else None
+        )
         sub_ing = _Ingested(
-            counts=counts[mask],
+            counts=sub_counts,
             norm_counts=None, pca=None, variable_features=None,
-            covariates=covariates[mask] if covariates is not None else None,
+            covariates=sub_cov,
             gene_names=None,
         )
         sub_key = depth_key(key, depth + 1, ci)
         sub_log = log.child()
         try:
             child, _, _ = _level(sub_key, sub_ing, sub_cfg, sub_log, depth + 1)
+            child = child[:n_c]
             if len(set(child.tolist())) > 1:
                 child = _iterate(
                     sub_key, counts[mask],

@@ -35,45 +35,72 @@ class SNNGraph(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=())
 def _rank_weights(idx: jax.Array) -> jax.Array:
-    """w[i, a] = k - r/2 for edge i -> idx[i, a] under the rank rule."""
+    """w[i, a] = k - r/2 for edge i -> idx[i, a] under the rank rule.
+
+    r is min_{p,q}(p + q) over matching members, computed as a scan over
+    the p axis with a [n, k, k+1] transient per step — the one-shot 4-D
+    eq tensor ([n, k, (k+1)^2] elements) is a TPU bandwidth wall at n >= 10k,
+    and the per-step compare+min fuses on the VPU.
+    """
     n, k = idx.shape
     self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
     lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k+1], rank = position
-    ranks = jnp.arange(k + 1, dtype=jnp.float32)
-    my = lists                                                # [n, k+1]
     other = lists[idx]                                        # [n, k, k+1]
-    eq = my[:, None, :, None] == other[:, :, None, :]         # [n, k, k+1, k+1]
-    ranksum = ranks[:, None] + ranks[None, :]                 # [k+1, k+1]
-    r = jnp.min(jnp.where(eq, ranksum[None, None], jnp.inf), axis=(2, 3))  # [n, k]
+    qranks = jnp.arange(k + 1, dtype=jnp.float32)
+
+    def body(r, p):
+        mask = lists[:, p][:, None, None] == other            # [n, k, k+1]
+        best_q = jnp.min(jnp.where(mask, qranks[None, None, :], jnp.inf), axis=2)
+        return jnp.minimum(r, p.astype(jnp.float32) + best_q), None
+
+    # `+ idx[0,0]*0` inherits idx's varying-manual-axes type so the carry
+    # typechecks inside shard_map (scan-vma rule; see leiden.py)
+    r0 = jnp.full((n, k), jnp.inf) + (idx[0, 0] * 0).astype(jnp.float32)
+    r, _ = jax.lax.scan(body, r0, jnp.arange(k + 1))
     return jnp.maximum(k - r / 2.0, 0.0)
 
 
 @jax.jit
 def snn_graph(idx: jax.Array) -> SNNGraph:
-    """Build the symmetric rank-weighted SNN graph from kNN indices [n, k]."""
+    """Build the symmetric rank-weighted SNN graph from kNN indices [n, k].
+
+    Per-slot work is expressed as scans of 1-D-indexed gathers/scatters:
+    2-D gathers whose index arrays are themselves computed lower ~30x slower
+    on TPU than their 1-D or constant-index forms (see cluster/leiden.py's
+    identical restructuring).
+    """
     idx = jnp.asarray(idx, jnp.int32)
     n, k = idx.shape
     w_out = _rank_weights(idx)                                # [n, k]
+    node_ids = jnp.arange(n, dtype=idx.dtype)
 
-    # mutual[i, a] = i in kNN(idx[i, a])
-    mutual = jnp.any(idx[idx] == jnp.arange(n, dtype=idx.dtype)[:, None, None], axis=2)
+    # mutual[i, a] = i in kNN(idx[i, a]); per-slot scan keeps the row gather
+    # 1-D-indexed ([n] computed ids picking [n, k] rows)
+    def mutual_slot(_, col):
+        return _, jnp.any(idx[col] == node_ids[:, None], axis=1)
+
+    _, mutual_t = jax.lax.scan(mutual_slot, None, jnp.moveaxis(idx, 1, 0))
+    mutual = jnp.moveaxis(mutual_t, 0, 1)                     # [n, k]
 
     # Reverse edges: for non-mutual (i -> j), give j an in-edge slot (j -> i).
     # Slot (j, a) receives the source whose a-th neighbour is j; collisions
     # (several sources sharing the a-th-neighbour j) keep one arbitrarily —
     # the dropped duplicates are rare and only shave edge weight, never add.
-    self_rows = jnp.broadcast_to(jnp.arange(n, dtype=idx.dtype)[:, None], idx.shape)
-    keep = ~mutual
-    src = jnp.where(keep, self_rows, -1)
-    cols = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], idx.shape)
-    rev_nbr = jnp.full((n, k), -1, jnp.int32).at[idx, cols].max(src)  # winner = max src id
-    got = rev_nbr >= 0
-    # Winner's weight comes from the *same* source edge: reverse slot (j, a)
-    # was written by edge (s, a) with idx[s, a] == j, so its weight is
-    # w_out[s, a] for the winning s.
-    safe_src = jnp.maximum(rev_nbr, 0)
-    rev_w = jnp.where(got, w_out[safe_src, cols], 0.0)
-    rev_nbr = jnp.where(got, rev_nbr, jnp.arange(n, dtype=jnp.int32)[:, None])
+    src = jnp.where(~mutual, node_ids[:, None], -1)
+
+    def rev_slot(_, slot):
+        col, src_col, w_col = slot
+        rn = jnp.full((n,), -1, jnp.int32).at[col].max(src_col)   # 1-D scatter
+        got = rn >= 0
+        rw = jnp.where(got, w_col[jnp.maximum(rn, 0)], 0.0)       # 1-D gather
+        return _, (jnp.where(got, rn, node_ids), rw)
+
+    _, (rev_nbr_t, rev_w_t) = jax.lax.scan(
+        rev_slot, None,
+        (jnp.moveaxis(idx, 1, 0), jnp.moveaxis(src, 1, 0), jnp.moveaxis(w_out, 1, 0)),
+    )
+    rev_nbr = jnp.moveaxis(rev_nbr_t, 0, 1)                   # [n, k]
+    rev_w = jnp.moveaxis(rev_w_t, 0, 1)
 
     nbr = jnp.concatenate([idx, rev_nbr], axis=1)
     w = jnp.concatenate([w_out, rev_w], axis=1)

@@ -101,6 +101,13 @@ class ClusterConfig:
     # the distributed step is one fused program with no chunk boundary to
     # checkpoint at (a "checkpoint_skipped" log event records the drop).
     checkpoint_dir: Optional[str] = None
+    # Pad iterate-subproblem shapes to geometric ~1.3x buckets so deep
+    # iterate=True runs reuse jit caches instead of recompiling per subcluster
+    # size (SURVEY §7.3 item 2). Cells pad by cyclic duplication — the same
+    # with-replacement duplication the bootstrap itself performs — and PC dims
+    # pad with inert zero columns; child labels are sliced back. Disable for
+    # exact unpadded per-subcluster statistics.
+    shape_buckets: bool = True
     # Dense [n, n] consensus-matrix assembly: None = auto (dense up to
     # 16384 cells, blockwise streaming above — consensus/blockwise.py), or
     # force with True/False. The blockwise path computes the consensus kNN

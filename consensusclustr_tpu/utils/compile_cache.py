@@ -22,6 +22,14 @@ def enable_persistent_cache() -> None:
     global _done
     if _done or os.environ.get("CCTPU_NO_COMPILE_CACHE"):
         return
+    # XLA:CPU executable deserialization is unreliable (observed: SIGSEGV in
+    # compilation_cache.get_executable_and_time on a cache hit written by the
+    # SAME process's host, plus "machine features mismatch ... SIGILL"
+    # warnings from the AOT loader). CPU compiles are cheap anyway — the
+    # cache only pays for itself on accelerators, so enable it only there.
+    if jax.default_backend() == "cpu":
+        _done = True
+        return
     cache_dir = os.environ.get(
         "CCTPU_COMPILE_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "consensusclustr_tpu", "xla"),

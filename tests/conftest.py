@@ -47,3 +47,18 @@ def make_blobs(n_per=60, n_genes=40, n_clusters=3, sep=6.0, seed=0):
 @pytest.fixture()
 def blobs():
     return make_blobs()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_vma_growth():
+    """Free compiled executables after every test module.
+
+    Each XLA:CPU executable pins multiple memory mappings; the full suite
+    compiles enough programs to exhaust vm.max_map_count (65530 default),
+    at which point LLVM's next mmap fails and the process segfaults inside
+    a compile (observed: /proc/<pid>/maps at ~64k right before SIGSEGV in
+    test_prep). Clearing jax's caches per module keeps the count bounded at
+    the cost of cross-module recompiles.
+    """
+    yield
+    jax.clear_caches()

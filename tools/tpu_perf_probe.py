@@ -1,0 +1,72 @@
+"""One-shot TPU perf probe: phase timings for the boot grid at a given size.
+
+Run on the real chip (no JAX_PLATFORMS override) when the tunnel is healthy:
+
+    python tools/tpu_perf_probe.py [n_cells] [n_res]
+
+Prints per-phase wall times with host-fetch synchronisation (the tunnel's
+block_until_ready is unreliable — see memory notes), RTT-corrected.
+"""
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def fetch_bench(fn, *args, reps=3, rtt=0.067):
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return max((time.time() - t0) / reps - rtt, 0.0)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 9000
+    n_res = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    print(f"backend={jax.default_backend()} n={n} n_res={n_res}", flush=True)
+
+    from consensusclustr_tpu.cluster.knn import knn_points
+    from consensusclustr_tpu.cluster.leiden import leiden_fixed, _local_moves
+    from consensusclustr_tpu.cluster.snn import snn_graph
+    from consensusclustr_tpu.cluster.engine import cluster_grid
+
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(n, 20)).astype(np.float32))
+    key = jax.random.key(0)
+    res_list = jnp.linspace(0.05, 1.5, n_res)
+
+    t = fetch_bench(lambda: knn_points(x, 20))
+    print(f"knn_points:        {t*1e3:8.1f} ms", flush=True)
+    idx, _ = knn_points(x, 20)
+    t = fetch_bench(lambda: snn_graph(idx))
+    print(f"snn_graph:         {t*1e3:8.1f} ms", flush=True)
+    g = snn_graph(idx)
+
+    keys = jax.random.split(key, n_res)
+    lab0 = jnp.arange(n, dtype=jnp.int32)
+    vm_local = jax.jit(
+        jax.vmap(lambda k, res: _local_moves(k, g, lab0, res, 20))
+    )
+    t = fetch_bench(lambda: vm_local(keys, res_list))
+    print(f"local_moves x{n_res}:  {t*1e3:8.1f} ms", flush=True)
+    vm_leiden = jax.jit(jax.vmap(lambda k, res: leiden_fixed(k, g, res)))
+    t = fetch_bench(lambda: vm_leiden(keys, res_list))
+    print(f"leiden full x{n_res}:  {t*1e3:8.1f} ms", flush=True)
+
+    grid = jax.jit(
+        lambda: cluster_grid(
+            key, x, res_list, (10, 15, 20), jnp.float32(0.0), max_clusters=64
+        )
+    )
+    t = fetch_bench(grid, reps=2)
+    print(f"cluster_grid k=3:  {t*1e3:8.1f} ms  ({t:.2f} s/boot)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

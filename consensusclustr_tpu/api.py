@@ -45,6 +45,10 @@ from consensusclustr_tpu.utils.rng import cluster_key, depth_key, root_key
 # The significance gate's small-cluster threshold is hardcoded 50 in the
 # reference (:521), independent of the minSize parameter.
 _GATE_SMALL_CLUSTER = 50
+# Above this, the gate's dendrogram streams cluster-pair distance sums
+# instead of materialising the [n, n] Euclidean matrix (same threshold as
+# consensus/pipeline.py's DENSE_CONSENSUS_LIMIT).
+_DENSE_GATE_LIMIT = 16384
 
 
 @dataclasses.dataclass
@@ -520,15 +524,56 @@ def _level(
     labels = np.asarray([str(l + 1) for l in cons.labels], dtype=object)
 
     # --- significance gate (:514-539) -------------------------------------
-    sizes = np.unique(cons.labels, return_counts=True)[1]
+    # On bucket-padded subproblems the gate and null test see ONLY the real
+    # cells: duplicate rows would inflate cluster sizes and silhouettes,
+    # bypassing tests that the unpadded subproblem would run. The test's
+    # outcome is a per-cluster label mapping, so it extends to duplicates.
+    n_real = int(cfg.n_real_cells) if cfg.n_real_cells else n
+    labels_real = labels[:n_real]
+    sizes = np.unique(labels_real, return_counts=True)[1]
     any_small = bool((sizes < _GATE_SMALL_CLUSTER).any())  # quirk 7: "any"
-    if len(sizes) > 1 and (cons.silhouette <= cfg.silhouette_thresh or any_small):
+    if n_real == n:
+        sil_gate = cons.silhouette
+    else:
+        from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+
+        _, codes_real = np.unique(labels_real.astype(str), return_inverse=True)
+        sil_gate = float(
+            mean_silhouette_score(
+                jnp.asarray(pca[:n_real], jnp.float32),
+                jnp.asarray(codes_real.astype(np.int32)),
+                max(cfg.max_clusters, int(codes_real.max()) + 1),
+            )
+        )
+    if len(sizes) > 1 and (sil_gate <= cfg.silhouette_thresh or any_small):
         if counts_hvg is None:
             log.event("null_test_skipped", reason="no raw counts available")
         else:
-            dend = determine_hierarchy(_euclidean(pca), labels)
-            labels = test_splits(
-                counts_hvg, pca, dend, labels,
+            dense_gate = (
+                cfg.dense_consensus
+                if cfg.dense_consensus is not None
+                else len(labels) <= _DENSE_GATE_LIMIT
+            )
+            if dense_gate:
+                dend = determine_hierarchy(_euclidean(pca[:n_real]), labels_real)
+            else:
+                # scale regime: the gate's PCA-distance dendrogram (:523)
+                # streams cluster-pair sums instead of the [n, n] matrix
+                from consensusclustr_tpu.consensus.blockwise import (
+                    euclidean_cluster_distance,
+                )
+                from consensusclustr_tpu.hierarchy.dendro import (
+                    _sorted_unique,
+                    dendrogram_from_cluster_distance,
+                )
+
+                uniq = _sorted_unique(labels_real)
+                code_of = {u: i for i, u in enumerate(uniq)}
+                codes = np.asarray([code_of[l] for l in labels_real], np.int32)
+                cmat = euclidean_cluster_distance(pca[:n_real], codes)
+                dend = dendrogram_from_cluster_distance(cmat, uniq)
+            tested = test_splits(
+                counts_hvg[:n_real], pca[:n_real], dend, labels_real,
                 pc_num=int(pc_num), k_num=cfg.k_num, alpha=cfg.alpha,
                 silhouette_thresh=cfg.silhouette_thresh,
                 covariates=ing.covariates, n_sims=cfg.n_null_sims,
@@ -536,6 +581,13 @@ def _level(
                 test_separately=cfg.test_splits_separately,
                 max_clusters=cfg.max_clusters, log=log,
                 cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
+            )
+            # merges act on whole clusters, so the outcome is a label map
+            mapping = {}
+            for old, new in zip(labels_real, tested):
+                mapping.setdefault(old, new)
+            labels = np.asarray(
+                [mapping.get(l, l) for l in labels], dtype=object
             )
             labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))
@@ -587,17 +639,23 @@ def _iterate(
         n_c = int(mask.sum())
         if n_c <= cfg.min_size:
             continue
-        sub_cfg = cfg.replace(variable_features=None, depth=depth + 1)
         # Shape bucketing (SURVEY §7.3 item 2): pad the subproblem's cell
         # count to the geometric bucket by cyclic duplication — the same
         # with-replacement duplication the bootstrap already performs, so
         # every downstream kernel handles it natively — and slice the child
         # labels back. Same-bucket subclusters then share every jit cache.
+        # n_real_cells makes the sub-level's significance gate + null test
+        # evaluate only the real rows.
         if cfg.shape_buckets:
             n_pad = _bucket_size(n_c)
             pad_idx = np.arange(n_pad) % n_c
         else:
+            n_pad = n_c
             pad_idx = np.arange(n_c)
+        sub_cfg = cfg.replace(
+            variable_features=None, depth=depth + 1,
+            n_real_cells=(n_c if n_pad != n_c else None),
+        )
         sub_counts = counts[mask][pad_idx]
         sub_cov = (
             covariates[mask][pad_idx] if covariates is not None else None

@@ -108,6 +108,12 @@ class ClusterConfig:
     # pad with inert zero columns; child labels are sliced back. Disable for
     # exact unpadded per-subcluster statistics.
     shape_buckets: bool = True
+    # Internal: set by the iterate driver on bucketed subproblems — the
+    # first n_real_cells rows are real, the rest cyclic duplicates. The
+    # significance gate and null test evaluate ONLY the real rows (padded
+    # duplicates would inflate cluster sizes past the 50-cell trigger and
+    # silhouettes past the threshold) and the outcome maps back by label.
+    n_real_cells: Optional[int] = None
     # Dense [n, n] consensus-matrix assembly: None = auto (dense up to
     # 16384 cells, blockwise streaming above — consensus/blockwise.py), or
     # force with True/False. The blockwise path computes the consensus kNN
@@ -116,9 +122,9 @@ class ClusterConfig:
     dense_consensus: Optional[bool] = None
     # Distributed execution: None = single chip; "auto" = shard over all
     # visible devices when >1; or an explicit jax.sharding.Mesh built by
-    # parallel.mesh.consensus_mesh. The pipeline falls back to single-chip
-    # (with a log event) when a level's shape can't shard (granular mode,
-    # nboots<=1, or n not divisible by the mesh's cell axis).
+    # parallel.mesh.consensus_mesh. Robust AND granular modes shard; the
+    # pipeline falls back to single-chip (with a log event) when a level's
+    # shape can't (nboots<=1, or n not divisible by the mesh's cell axis).
     mesh: Optional[object] = None
 
     def __post_init__(self):

@@ -213,6 +213,66 @@ def merge_small_clusters_from_sums(
         counts[smallest] = 0.0
 
 
+@functools.partial(jax.jit, static_argnames=("n_clusters", "block"))
+def euclidean_pair_sums(
+    x: jax.Array,          # [n, d] embedding
+    codes: jax.Array,      # [n] int32 cluster ids in [0, n_clusters)
+    n_clusters: int,
+    block: int = BW_BLOCK,
+) -> Tuple[jax.Array, jax.Array]:
+    """(sums [C, C], counts [C]) of pairwise Euclidean distances between
+    cluster members, streamed in [block, n] tiles — the significance gate's
+    dendrogram input (reference :523 `dist(pca)`) without the [n, n]."""
+    x = jnp.asarray(x, jnp.float32)
+    codes = jnp.asarray(codes, jnp.int32)
+    n, d = x.shape
+    n_blocks = -(-n // block)
+    n_pad = n_blocks * block
+    x_pad = jnp.zeros((n_pad, d), jnp.float32).at[:n].set(x)
+    sq = jnp.sum(x * x, axis=1)
+    sq_pad = jnp.zeros((n_pad,), jnp.float32).at[:n].set(sq)
+    oh = (codes[:, None] == jnp.arange(n_clusters)[None, :]).astype(jnp.float32)
+    codes_pad = jnp.concatenate([codes, jnp.full((n_pad - n,), -1, jnp.int32)])
+    oh_pad = (codes_pad[:, None] == jnp.arange(n_clusters)[None, :]).astype(
+        jnp.float32
+    )
+    rows_local = jnp.arange(block, dtype=jnp.int32)
+
+    def one_block(acc, i):
+        xb = jax.lax.dynamic_slice(x_pad, (i * block, 0), (block, d))
+        sqb = jax.lax.dynamic_slice(sq_pad, (i * block,), (block,))
+        d2 = sqb[:, None] - 2.0 * (xb @ x.T) + sq[None, :]
+        dist = jnp.sqrt(jnp.maximum(d2, 0.0))                    # [block, n]
+        self_col = jnp.clip(i * block + rows_local, 0, n - 1)
+        dist = dist.at[rows_local, self_col].set(0.0)
+        ohr = jax.lax.dynamic_slice_in_dim(oh_pad, i * block, block, axis=0)
+        return acc + ohr.T @ (dist @ oh), None
+
+    sums, _ = jax.lax.scan(
+        one_block, jnp.zeros((n_clusters, n_clusters), jnp.float32),
+        jnp.arange(n_blocks, dtype=jnp.int32),
+    )
+    return sums, jnp.sum(oh, axis=0)
+
+
+def euclidean_cluster_distance(
+    x: np.ndarray, codes: np.ndarray, block: int = BW_BLOCK
+) -> np.ndarray:
+    """[C, C] mean pairwise Euclidean distance between cluster members,
+    streamed — determineHierachy(return="distance") on `dist(pca)` without
+    materialising it (reference :523, :699-735)."""
+    codes = np.asarray(codes, np.int32)
+    n_clusters = int(codes.max()) + 1
+    sums, counts = euclidean_pair_sums(
+        jnp.asarray(x, jnp.float32), jnp.asarray(codes), n_clusters, block
+    )
+    sums = np.asarray(sums, np.float64)
+    counts = np.asarray(counts, np.float64)
+    denom = np.outer(counts, counts)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return np.where(denom > 0, sums / np.maximum(denom, 1.0), np.inf)
+
+
 def cocluster_cluster_distance(
     boot_labels: np.ndarray, codes: np.ndarray, max_clusters: int = 64
 ) -> np.ndarray:

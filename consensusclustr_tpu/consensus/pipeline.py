@@ -295,8 +295,6 @@ def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
     reason = None
     if cfg.nboots <= 1:
         reason = "nboots<=1"
-    elif cfg.mode != "robust":
-        reason = "granular mode"
     else:
         from consensusclustr_tpu.parallel.mesh import CELL_AXIS, consensus_mesh
 

@@ -39,6 +39,60 @@ from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
     jax.jit,
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun",
+        "compute_dtype",
+    ),
+)
+def sharded_run_bootstraps_granular(
+    keys: jax.Array,       # [B] per-boot PRNG keys
+    idx: jax.Array,        # [B, m] int32 bootstrap gathers
+    pca: jax.Array,        # [n, d] float32, replicated
+    res_list: jax.Array,   # [R]
+    mesh: jax.sharding.Mesh,
+    k_list: Tuple[int, ...],
+    max_clusters: int,
+    n_cells: int,
+    n_iters: int = 20,
+    cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
+) -> Tuple[jax.Array, jax.Array]:
+    """Granular-mode bootstraps over the mesh: EVERY (k, resolution)
+    candidate of every bootstrap is kept (reference :688), aligned to cells.
+
+    Returns (labels [B, |k|*R, n] int32 with -1 for unsampled, scores
+    [B, |k|*R]), boot axis sharded over the flattened ("boot", "cell") mesh.
+    """
+    n_dev = mesh.shape[BOOT_AXIS] * mesh.shape[CELL_AXIS]
+    if idx.shape[0] % n_dev:
+        raise ValueError(
+            f"B={idx.shape[0]} not divisible by device count {n_dev}"
+        )
+
+    def kernel(keys_local, idx_local, pca_rep, res_rep):
+        def one(key_b, idx_b):
+            x = pca_rep[idx_b]
+            grid = cluster_grid(
+                key_b, x, res_rep, k_list, jnp.float32(0.0),
+                max_clusters=max_clusters, n_iters=n_iters,
+                cluster_fun=cluster_fun, compute_dtype=compute_dtype,
+            )
+            aligned = align_to_cells(grid.labels, idx_b, n_cells)  # [cand, n]
+            return aligned, grid.scores
+
+        return jax.vmap(one)(keys_local, idx_local)
+
+    both = (BOOT_AXIS, CELL_AXIS)
+    return jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(both), P(both, None), P(None, None), P(None)),
+        out_specs=(P(both, None, None), P(both, None)),
+    )(keys, idx, jnp.asarray(pca, jnp.float32), jnp.asarray(res_list, jnp.float32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun",
         "compute_dtype"
     ),
 )

@@ -38,7 +38,10 @@ from consensusclustr_tpu.cluster.leiden import compact_labels
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
-from consensusclustr_tpu.parallel.boots import sharded_run_bootstraps
+from consensusclustr_tpu.parallel.boots import (
+    sharded_run_bootstraps,
+    sharded_run_bootstraps_granular,
+)
 from consensusclustr_tpu.parallel.cocluster import (
     sharded_blockwise_consensus_knn,
     sharded_coclustering_distance,
@@ -104,7 +107,7 @@ class DistributedStepResult(NamedTuple):
     jax.jit,
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun",
-        "compute_dtype", "dense",
+        "compute_dtype", "dense", "granular",
     ),
 )
 def distributed_consensus_step(
@@ -122,20 +125,35 @@ def distributed_consensus_step(
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
     dense: bool = True,
+    granular: bool = False,
 ) -> DistributedStepResult:
     n, _ = pca.shape
     b_pad = idx.shape[0]
 
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(b_pad))
-    boot_labels, _ = sharded_run_bootstraps(
-        keys, idx, pca, res_list[:n_res_real], mesh, k_list,
-        max_clusters, n, n_iters=n_iters, cluster_fun=cluster_fun,
-        compute_dtype=compute_dtype,
-    )
-    # padding boots contribute nothing to the co-clustering counts
-    boot_labels = jnp.where(
-        (jnp.arange(b_pad) < n_real_boots)[:, None], boot_labels, -1
-    )
+    if granular:
+        # every (k, res) candidate of every bootstrap joins the consensus
+        # (reference :688); the flattened candidate axis feeds the same
+        # sharded co-clustering as robust mode's boot axis
+        labels_g, _ = sharded_run_bootstraps_granular(
+            keys, idx, pca, res_list[:n_res_real], mesh, k_list,
+            max_clusters, n, n_iters=n_iters, cluster_fun=cluster_fun,
+            compute_dtype=compute_dtype,
+        )
+        labels_g = jnp.where(
+            (jnp.arange(b_pad) < n_real_boots)[:, None, None], labels_g, -1
+        )
+        boot_labels = labels_g.reshape(-1, n)          # [B_pad * |k|*R, n]
+    else:
+        boot_labels, _ = sharded_run_bootstraps(
+            keys, idx, pca, res_list[:n_res_real], mesh, k_list,
+            max_clusters, n, n_iters=n_iters, cluster_fun=cluster_fun,
+            compute_dtype=compute_dtype,
+        )
+        # padding boots contribute nothing to the co-clustering counts
+        boot_labels = jnp.where(
+            (jnp.arange(b_pad) < n_real_boots)[:, None], boot_labels, -1
+        )
     if dense:
         dist = sharded_coclustering_distance(boot_labels, mesh, max_clusters)
         knn_all, _ = sharded_knn_from_distance(dist, mesh, max(k_list))
@@ -179,8 +197,9 @@ def distributed_consensus_cluster(
     dense: bool = True,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
     """Host wrapper: pad the boot and resolution axes to the mesh, run the
-    fused step, return (labels [n], dist [n, n] or None, boot_labels [B, n])
-    as numpy.
+    fused step, return (labels [n], dist [n, n] or None, boot_labels as
+    numpy — [B, n] in robust mode, [B * |k|*|res|, n] in granular mode,
+    exactly the single-chip run_bootstraps layouts).
 
     n must divide by the mesh's "cell" extent (the row-sharding granularity).
     `return_dist=False` skips the host gather of the dense distance matrix —
@@ -204,14 +223,18 @@ def distributed_consensus_cluster(
     res_arr = jnp.asarray(res + [res[-1]] * (r_pad - r_real), jnp.float32)
     res_mask = jnp.asarray([1.0] * r_real + [0.0] * (r_pad - r_real), jnp.float32)
 
+    granular = cfg.mode == "granular"
     out = distributed_consensus_step(
         key, pca, idx, res_arr, res_mask, jnp.int32(cfg.nboots), mesh,
         tuple(int(k) for k in cfg.k_num), cfg.max_clusters, r_real,
         cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
-        dense=dense,
+        dense=dense, granular=granular,
+    )
+    n_real_rows = cfg.nboots * (
+        len(cfg.k_num) * r_real if granular else 1
     )
     return (
         np.asarray(out.labels),
         np.asarray(out.dist) if (return_dist and out.dist is not None) else None,
-        np.asarray(out.boot_labels[: cfg.nboots]),
+        np.asarray(out.boot_labels[:n_real_rows]),
     )

@@ -200,3 +200,19 @@ def test_sharded_blockwise_knn_pads_indivisible_n():
     assert idx_s.shape == (650, 10)
     assert int(np.asarray(idx_s).max()) < 650  # no padded ids leak
     np.testing.assert_allclose(np.asarray(d_s), np.asarray(d_1), atol=1e-5)
+
+
+def test_euclidean_cluster_distance_matches_dense():
+    from consensusclustr_tpu.consensus.blockwise import euclidean_cluster_distance
+    from consensusclustr_tpu.hierarchy.dendro import cluster_distance_matrix
+
+    r = np.random.default_rng(11)
+    x = r.normal(size=(300, 6)).astype(np.float32)
+    codes = r.integers(0, 4, size=300).astype(np.int32)
+    d = np.sqrt(np.maximum(
+        (x**2).sum(1)[:, None] - 2 * x @ x.T + (x**2).sum(1)[None, :], 0
+    ))
+    want, _ = cluster_distance_matrix(d, codes)
+    got = euclidean_cluster_distance(x, codes, block=128)
+    off = ~np.eye(4, dtype=bool)
+    np.testing.assert_allclose(got[off], want[off], rtol=1e-4, atol=1e-4)

@@ -224,7 +224,25 @@ def test_mesh_fallback_granular_and_indivisible():
     mesh = consensus_mesh(boot=4, cell=2)
     cfg = ClusterConfig(nboots=4, mesh=mesh)
     assert _resolve_mesh(cfg, 64) is mesh
-    assert _resolve_mesh(cfg.replace(mode="granular"), 64) is None
+    assert _resolve_mesh(cfg.replace(mode="granular"), 64) is mesh  # shards too
     assert _resolve_mesh(cfg.replace(nboots=0), 64) is None
     assert _resolve_mesh(cfg, 63) is None   # 63 % 2 != 0
     assert _resolve_mesh(cfg.replace(mesh=None), 64) is None
+
+
+def test_consensus_clust_mesh_granular_bit_identical():
+    """Granular mode shards too (SURVEY §2.4 rows 1-2): every (k, res)
+    candidate of every boot joins the consensus, bit-identical to the
+    single-chip granular path across mesh shapes."""
+    from consensusclustr_tpu.api import consensus_clust
+
+    counts = _nb_counts()
+    kw = dict(
+        nboots=8, n_var_features=60, pc_num=6, min_size=10, mode="granular",
+        k_num=(5, 10), res_range=(0.05, 0.3, 0.8), max_clusters=16, seed=5,
+    )
+    single = consensus_clust(counts, **kw).assignments
+    mesh8 = consensus_mesh(boot=4, cell=2)
+    dist = consensus_clust(counts, mesh=mesh8, **kw).assignments
+    assert len(set(single.tolist())) > 1
+    np.testing.assert_array_equal(single, dist)

@@ -535,16 +535,9 @@ def _level(
     if n_real == n:
         sil_gate = cons.silhouette
     else:
-        from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
+        from consensusclustr_tpu.nulltest.splits import _silhouette
 
-        _, codes_real = np.unique(labels_real.astype(str), return_inverse=True)
-        sil_gate = float(
-            mean_silhouette_score(
-                jnp.asarray(pca[:n_real], jnp.float32),
-                jnp.asarray(codes_real.astype(np.int32)),
-                max(cfg.max_clusters, int(codes_real.max()) + 1),
-            )
-        )
+        sil_gate = _silhouette(pca[:n_real], labels_real, cfg.max_clusters)
     if len(sizes) > 1 and (sil_gate <= cfg.silhouette_thresh or any_small):
         if counts_hvg is None:
             log.event("null_test_skipped", reason="no raw counts available")
@@ -576,7 +569,12 @@ def _level(
                 counts_hvg[:n_real], pca[:n_real], dend, labels_real,
                 pc_num=int(pc_num), k_num=cfg.k_num, alpha=cfg.alpha,
                 silhouette_thresh=cfg.silhouette_thresh,
-                covariates=ing.covariates, n_sims=cfg.n_null_sims,
+                covariates=(
+                    ing.covariates[:n_real]
+                    if ing.covariates is not None
+                    else None
+                ),
+                n_sims=cfg.n_null_sims,
                 key=cluster_key(key, "nulltest"),
                 test_separately=cfg.test_splits_separately,
                 max_clusters=cfg.max_clusters, log=log,

@@ -276,8 +276,9 @@ def _consensus_grid(
 def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
     """Resolve cfg.mesh to a usable Mesh or None (single-chip).
 
-    Falls back (with a log event) when the level cannot shard: granular mode,
-    nboots<=1, a 1-device mesh, or n not divisible by the cell axis.
+    Falls back (with a log event) when the level cannot shard: nboots<=1,
+    a 1-device mesh, or n not divisible by the cell axis. Robust AND
+    granular modes both shard.
     """
     m = cfg.mesh
     if m is None:
@@ -414,14 +415,32 @@ def consensus_cluster(
         )
         best = int(_ties_last_argmax(grid.scores))
         labels = np.asarray(grid.labels[best])
-        # Euclidean distances for the small-cluster merge (:504-510)
-        d2 = np.asarray(
-            jnp.sqrt(jnp.maximum(
-                jnp.sum(pca**2, 1)[:, None] - 2 * pca @ pca.T + jnp.sum(pca**2, 1)[None, :],
-                0.0,
-            ))
-        )
-        labels = merge_small_clusters(d2, labels, max(k_list[0], 30), cfg.max_clusters)
+        # Euclidean small-cluster merge (:504-510): dense matrix below the
+        # scale threshold, streamed cluster-pair sums above it
+        dense = cfg.dense_consensus
+        if dense is None:
+            dense = n <= DENSE_CONSENSUS_LIMIT
+        if dense:
+            d2 = np.asarray(
+                jnp.sqrt(jnp.maximum(
+                    jnp.sum(pca**2, 1)[:, None] - 2 * pca @ pca.T + jnp.sum(pca**2, 1)[None, :],
+                    0.0,
+                ))
+            )
+            labels = merge_small_clusters(d2, labels, max(k_list[0], 30), cfg.max_clusters)
+        else:
+            from consensusclustr_tpu.consensus.blockwise import (
+                euclidean_pair_sums,
+                merge_small_clusters_from_sums,
+            )
+
+            esums, ecounts = euclidean_pair_sums(
+                pca, jnp.asarray(labels, jnp.int32), cfg.max_clusters
+            )
+            labels = merge_small_clusters_from_sums(
+                np.asarray(esums), np.asarray(ecounts), labels,
+                max(k_list[0], 30),
+            )
         sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
         if log:
             log.event("no_boot_result", n_clusters=len(np.unique(labels)), silhouette=sil)

@@ -81,3 +81,26 @@ def test_bucket_padding_preserves_label_alignment():
     for i, s in enumerate(sizes):
         seg = out[sum(sizes[:i]) : sum(sizes[: i + 1])]
         assert all(str(l).split("_")[0] == str(i + 1) for l in seg)
+
+
+def test_bucketed_gate_with_covariates_runs():
+    """Covariates must be sliced to the real rows when the bucketed gate
+    enters the null test (regression: padded-row covariates vs real-row
+    counts raised a shape error)."""
+    r = np.random.default_rng(3)
+    sizes = [90, 130]
+    counts = np.concatenate([_two_blob_group(r, s, 20, sep=0.5) for s in sizes])
+    labels = np.concatenate(
+        [np.full(s, str(i + 1), dtype=object) for i, s in enumerate(sizes)]
+    )
+    cov = r.normal(size=(len(labels), 1)).astype(np.float32)
+    cfg = ClusterConfig(
+        nboots=4, k_num=(8,), res_range=(0.1, 0.6), pc_num=5,
+        n_var_features=16, min_size=80, max_clusters=16, n_null_sims=2,
+        vars_to_regress=cov, skip_first_regression=True,
+    )
+    out = _iterate(
+        root_key(4), counts.astype(np.float32), cov, labels, cfg,
+        LevelLog(enabled=False), depth=1,
+    )
+    assert len(out) == sum(sizes)

@@ -26,12 +26,16 @@ from consensusclustr_tpu.config import DEFAULT_RES_RANGE
 from consensusclustr_tpu.cluster.knn import knn_points
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.cluster.leiden import (
+    DEFAULT_COMMUNITY_ITERS,
     compact_labels,
     leiden_fixed,
     louvain_fixed,
 )
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.utils.rng import cluster_key, root_key
+
+# DEFAULT_COMMUNITY_ITERS is re-exported from cluster.leiden (the single
+# source of truth, next to the paired _auto_kc coarse-size policy).
 
 
 class GridResult(NamedTuple):
@@ -102,7 +106,7 @@ def community_detect(
     graph,
     res: jax.Array,
     cluster_fun: str = "leiden",
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     update_frac: float = 0.5,
 ) -> jax.Array:
     """Dispatch to the selected community-detection kernel. The reference
@@ -127,7 +131,7 @@ def cluster_grid(
     k_list: Tuple[int, ...],
     min_size: jax.Array,
     max_clusters: int = 64,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     update_frac: float = 0.5,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
@@ -205,7 +209,7 @@ def get_clust_assignments(
     n_cells: Optional[int] = None,
     max_clusters: int = 64,
     key: Optional[jax.Array] = None,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
 ):
     """Public engine API (reference export, NAMESPACE:5).
 

@@ -30,12 +30,14 @@ import numpy as np
 
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.cluster.engine import (
+    DEFAULT_COMMUNITY_ITERS,
     align_to_cells,
     cluster_grid,
     community_detect,
     ties_last_argmax as _ties_last_argmax,
 )
 from consensusclustr_tpu.cluster.knn import knn_from_distance
+from consensusclustr_tpu.cluster.leiden import _auto_kc as _leiden_auto_kc
 from consensusclustr_tpu.cluster.leiden import compact_labels
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.cluster.engine import consensus_candidate_score
@@ -116,11 +118,15 @@ def _auto_boot_chunk(
     # gracefully) when pushed, so track a conservative budget against the
     # 16 GB HBM.
     from consensusclustr_tpu.cluster.knn import KNN_BLOCK
-    from consensusclustr_tpu.cluster.leiden import _SLAB
+    from consensusclustr_tpu.cluster.leiden import _SLAB, _auto_kc
 
     e = 2 * k_max
     knn_bytes = (m * m if m <= 2 * KNN_BLOCK else KNN_BLOCK * m) * 4.0
-    per_boot = knn_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
+    # coarse community-merge phase: ~6 live [kc, kc] f32 matrices per
+    # resolution instance (big_w, its transpose-fold, gain, outer(k_deg))
+    kc = min(_auto_kc(m), m)
+    coarse_bytes = n_res * kc * kc * 4.0 * 6.0
+    per_boot = knn_bytes + coarse_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
     backend = jax.default_backend()
     on_cpu = backend == "cpu"
     budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9 if on_cpu else 6e9))
@@ -173,6 +179,8 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
                 # a resume silently reuses chunks from a different algorithm
                 "cluster_fun": cfg.cluster_fun,
                 "compute_dtype": cfg.compute_dtype,
+                "n_iters": DEFAULT_COMMUNITY_ITERS,
+                "k_coarse": _leiden_auto_kc(m),
             },
             np.asarray(jax.random.key_data(key)).tobytes(),
         )
@@ -196,7 +204,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         labels, scores = _boot_batch(
             keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
             jnp.float32(0.0),
-            len(cfg.res_range), cfg.max_clusters, 20, robust, n,
+            len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS, robust, n,
             cfg.cluster_fun, cfg.compute_dtype,
         )
         out_labels.append(np.asarray(labels))
@@ -223,7 +231,7 @@ def _consensus_grid_from_knn(
     res_list: jax.Array,
     k_list,
     max_clusters: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
 ):
     """Consensus re-clustering (reference :423-441) from a precomputed kNN
@@ -263,7 +271,7 @@ def _consensus_grid(
     res_list: jax.Array,
     k_list,
     max_clusters: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
 ):
     """Dense-matrix entry: one kNN pass at max k, then the shared grid."""

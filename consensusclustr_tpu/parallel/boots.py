@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from consensusclustr_tpu.cluster.engine import (
+    DEFAULT_COMMUNITY_ITERS,
     align_to_cells,
     cluster_grid,
     ties_last_argmax,
@@ -51,7 +52,7 @@ def sharded_run_bootstraps_granular(
     k_list: Tuple[int, ...],
     max_clusters: int,
     n_cells: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
 ) -> Tuple[jax.Array, jax.Array]:
@@ -105,7 +106,7 @@ def sharded_run_bootstraps(
     k_list: Tuple[int, ...],
     max_clusters: int,
     n_cells: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
 ) -> Tuple[jax.Array, jax.Array]:

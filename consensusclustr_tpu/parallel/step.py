@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from consensusclustr_tpu.cluster.engine import (
+    DEFAULT_COMMUNITY_ITERS,
     community_detect,
     consensus_candidate_score,
 )
@@ -65,7 +66,7 @@ def _consensus_grid_sharded(
     ki: int,
     n_res: int,
     max_clusters: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
 ) -> Tuple[jax.Array, jax.Array]:
     """Leiden/Louvain over the resolution sweep, res axis sharded over the flattened
@@ -121,7 +122,7 @@ def distributed_consensus_step(
     k_list: Tuple[int, ...],
     max_clusters: int,
     n_res_real: int,
-    n_iters: int = 20,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
     dense: bool = True,

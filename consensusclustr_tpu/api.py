@@ -535,17 +535,19 @@ def _level(
     if n_real == n:
         sil_gate = cons.silhouette
     else:
-        from consensusclustr_tpu.nulltest.splits import _silhouette
+        from consensusclustr_tpu.nulltest.splits import labelled_silhouette
 
-        sil_gate = _silhouette(pca[:n_real], labels_real, cfg.max_clusters)
+        sil_gate = labelled_silhouette(pca[:n_real], labels_real, cfg.max_clusters)
     if len(sizes) > 1 and (sil_gate <= cfg.silhouette_thresh or any_small):
         if counts_hvg is None:
             log.event("null_test_skipped", reason="no raw counts available")
         else:
+            # gate on n_real, not the bucket-padded count: the dendrogram
+            # below is built on pca[:n_real] (ADVICE r3)
             dense_gate = (
                 cfg.dense_consensus
                 if cfg.dense_consensus is not None
-                else len(labels) <= _DENSE_GATE_LIMIT
+                else n_real <= _DENSE_GATE_LIMIT
             )
             if dense_gate:
                 dend = determine_hierarchy(_euclidean(pca[:n_real]), labels_real)
@@ -701,6 +703,16 @@ def consensus_clust(
     may be a dense [n_cells, n_genes] array, scipy sparse matrix, or an
     AnnData-like object; keyword `params` mirror the reference's arguments
     snake_cased (see ClusterConfig).
+
+    Note on ``iterate=True``: by default (``shape_buckets=True``) recursive
+    subproblems are padded to ~1.3x geometric size buckets by cyclically
+    duplicating cells, so sub-level size factors/HVGs/PCA see up to ~30%
+    duplicated rows — a deliberate deviation from the reference's exact
+    per-subcluster statistics that bounds XLA recompilation (docs/quirks.md
+    D7). The significance gate and null test always evaluate real cells
+    only. Pass ``shape_buckets=False`` for exact per-subcluster statistics
+    at the cost of one compile per distinct subproblem shape (cheap on CPU,
+    expensive on TPU).
 
     Returns ClusterResult(assignments, cluster_dendrogram, clustree) per the
     reference's result contract (SURVEY §8.3).

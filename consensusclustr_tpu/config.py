@@ -97,9 +97,12 @@ class ClusterConfig:
     compute_dtype: str = "float32"
     use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
     progress: bool = False      # structured per-level logging
-    # Persist boot chunks; resume on rerun. Single-chip robust mode only —
-    # the distributed step is one fused program with no chunk boundary to
-    # checkpoint at (a "checkpoint_skipped" log event records the drop).
+    # Persist boot chunks; a rerun with identical (data, config, seed)
+    # resumes at the first missing chunk. Covers single-chip AND mesh runs,
+    # robust AND granular (granular checkpoints the flattened |k|*|res|
+    # candidate axis). On a mesh the boot fan-out runs chunked (multiple of
+    # the device count, CCTPU_CKPT_CHUNK) instead of fused; results are
+    # bit-identical either way.
     checkpoint_dir: Optional[str] = None
     # Pad iterate-subproblem shapes to geometric ~1.3x buckets so deep
     # iterate=True runs reuse jit caches instead of recompiling per subcluster

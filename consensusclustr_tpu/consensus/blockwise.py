@@ -183,9 +183,12 @@ def merge_small_clusters_from_sums(
 ) -> np.ndarray:
     """Small-cluster merge (reference :462-467) from pair sums.
 
-    Exact equivalent of merge_small_clusters: the mean inter-member distance
-    between merged clusters is additive in (sums, counts), so the host loop
-    updates them in place instead of re-streaming tiles.
+    Equivalent to merge_small_clusters up to f32 accumulation order at ties:
+    the mean inter-member distance between merged clusters is additive in
+    (sums, counts), so the host loop updates them in place (in float64)
+    instead of re-streaming tiles, while the dense path recomputes cluster
+    means in f32 on device each iteration — a near-tie argmin target can
+    differ between the two (ADVICE r3; parity tests cover n <= 700).
     """
     labels = np.asarray(labels, np.int32).copy()
     sums = np.asarray(sums, np.float64).copy()

@@ -149,8 +149,9 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
 
     With cfg.checkpoint_dir set, each completed chunk is persisted and a rerun
     with identical (pca, config, seed) resumes at the first missing chunk
-    (SURVEY §5 checkpoint row; robust mode only — granular chunks depend on
-    the candidate grid shape and are cheap to recompute per candidate).
+    (SURVEY §5 checkpoint row). Granular mode checkpoints the flattened
+    candidate axis — |k_num| * |res_range| rows per boot — so the grid shape
+    is part of the fingerprint.
     """
     n, _ = pca.shape
     m = max(2, int(round(cfg.boot_size * n)))
@@ -163,7 +164,8 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     )
 
     ckpt = None
-    if cfg.checkpoint_dir and robust:
+    rows_per_boot = 1 if robust else len(k_list) * len(cfg.res_range)
+    if cfg.checkpoint_dir:
         from consensusclustr_tpu.utils.checkpoint import (
             BootCheckpoint,
             run_fingerprint,
@@ -172,6 +174,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         fp = run_fingerprint(
             np.asarray(pca),
             {
+                "mode": cfg.mode,
                 "nboots": cfg.nboots, "boot_size": cfg.boot_size,
                 "k_num": list(k_list), "res_range": list(cfg.res_range),
                 "max_clusters": cfg.max_clusters, "chunk": chunk,
@@ -184,7 +187,9 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
             },
             np.asarray(jax.random.key_data(key)).tobytes(),
         )
-        ckpt = BootCheckpoint(cfg.checkpoint_dir, fp, cfg.nboots, n)
+        ckpt = BootCheckpoint(
+            cfg.checkpoint_dir, fp, cfg.nboots, n, rows_per_boot=rows_per_boot
+        )
 
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
     out_labels, out_scores = [], []
@@ -193,8 +198,12 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         if ckpt is not None:
             cached = ckpt.load_chunk(s, e - s)
             if cached is not None:
-                out_labels.append(cached[0])
-                out_scores.append(cached[1])
+                if robust:
+                    out_labels.append(cached[0])
+                    out_scores.append(cached[1])
+                else:  # chunks store the flattened candidate axis
+                    out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
+                    out_scores.append(cached[1].reshape(e - s, rows_per_boot))
                 if log:
                     log.event("boots_resumed", done=e, total=cfg.nboots)
                 continue
@@ -210,7 +219,9 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         out_labels.append(np.asarray(labels))
         out_scores.append(np.asarray(scores))
         if ckpt is not None:
-            ckpt.save_chunk(s, out_labels[-1], out_scores[-1])
+            ckpt.save_chunk(
+                s, out_labels[-1].reshape(-1, n), out_scores[-1].reshape(-1)
+            )
         if log:
             log.event("boots", done=e, total=cfg.nboots)
     labels = np.concatenate(out_labels, axis=0)
@@ -393,15 +404,11 @@ def consensus_cluster(
             distributed_consensus_cluster,
         )
 
-        if cfg.checkpoint_dir and log:
-            # the fused sharded step has no per-chunk boundary to persist at;
-            # surface the contract change instead of silently dropping it
-            log.event("checkpoint_skipped", reason="distributed step is fused")
         dense = cfg.dense_consensus
         if dense is None:
             dense = n <= DENSE_CONSENSUS_LIMIT
         labels_np, dist_np, boot_labels = distributed_consensus_cluster(
-            key, pca, cfg, mesh, dense=dense
+            key, pca, cfg, mesh, dense=dense, log=log
         )
         if log:
             log.event(

@@ -145,3 +145,59 @@ def load_counts(path: str, transpose: bool = False) -> CountMatrix:
         return cm
 
     raise ValueError(f"unsupported counts format: {path}")
+
+
+def _read_tsv_rows(path: str) -> list:
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        # rstrip \r too: CRLF files would otherwise attach invisible \r to
+        # every name and break all downstream exact matching
+        return [line.rstrip("\r\n").split("\t") for line in f if line.strip()]
+
+
+def _read_tsv_column(path: str, column: int = 0) -> np.ndarray:
+    rows = _read_tsv_rows(path)
+    return np.asarray(
+        [r[min(column, len(r) - 1)] for r in rows], dtype=object
+    )
+
+
+def load_10x(directory: str) -> CountMatrix:
+    """Load a 10x Genomics Cell Ranger output directory.
+
+    The standard trio — `matrix.mtx[.gz]` (genes x cells MatrixMarket),
+    `barcodes.tsv[.gz]` (cell names) and `features.tsv[.gz]` (or the legacy
+    `genes.tsv`) — is the ingestion path the reference reaches through
+    Seurat's `Read10X` (reference README.md:30-38's Seurat workflow).
+    Returns cells x genes CSR with names attached. Like Read10X's
+    `gene.column = 2` default, gene_names are the symbol column when the
+    features file has one (so symbol-based `variable_features` match),
+    falling back to the id column.
+    """
+
+    def _find(*stems: str) -> Optional[str]:
+        for stem in stems:
+            for suffix in ("", ".gz"):
+                p = os.path.join(directory, stem + suffix)
+                if os.path.exists(p):
+                    return p
+        return None
+
+    mtx = _find("matrix.mtx")
+    if mtx is None:
+        raise FileNotFoundError(f"no matrix.mtx[.gz] in {directory!r}")
+    cm = load_counts(mtx, transpose=True)  # 10x ships genes x cells
+
+    barcodes = _find("barcodes.tsv")
+    if barcodes is not None:
+        names = _read_tsv_column(barcodes)
+        if len(names) == cm.shape[0]:
+            cm.cell_names = names
+    features = _find("features.tsv", "genes.tsv")
+    if features is not None:
+        names = _read_tsv_column(features, column=1)
+        if len(names) == cm.shape[1]:
+            cm.gene_names = names
+    return cm

@@ -48,12 +48,21 @@ def _codes(labels: np.ndarray) -> np.ndarray:
     return codes.astype(np.int32)
 
 
-def _silhouette(pca: np.ndarray, labels: np.ndarray, max_clusters: int) -> float:
+def labelled_silhouette(
+    pca: np.ndarray, labels: np.ndarray, max_clusters: int
+) -> float:
+    """Mean approx-silhouette of string/object labels on a PCA matrix.
+
+    Public helper shared by the dendrogram walk here and the significance
+    gate in api.py (reference :518's approxSilhouette-on-labels pattern)."""
     codes = _codes(labels)
     mc = max(int(max_clusters), int(codes.max()) + 1)
     return float(
         mean_silhouette_score(jnp.asarray(pca, jnp.float32), jnp.asarray(codes), mc)
     )
+
+
+_silhouette = labelled_silhouette  # internal callers / backward compat
 
 
 def _clustering_rejected(

@@ -50,11 +50,24 @@ class BootCheckpoint:
     checkpoint root without ever invalidating each other's chunks.
     """
 
-    def __init__(self, directory: str, fingerprint: str, nboots: int, n_cells: int):
+    def __init__(
+        self,
+        directory: str,
+        fingerprint: str,
+        nboots: int,
+        n_cells: int,
+        rows_per_boot: int = 1,
+    ):
+        """rows_per_boot > 1 is granular mode: each boot contributes its full
+        |k_num| * |res_range| candidate slab, stored flattened boot-major as
+        [chunk * rows_per_boot, n_cells] (the layout the consensus co-cluster
+        consumes). The fingerprint must include the grid shape so a changed
+        grid can never resume a stale slab."""
         self.dir = os.path.join(directory, fingerprint)
         self.fp = fingerprint
         self.nboots = nboots
         self.n_cells = n_cells
+        self.rows_per_boot = rows_per_boot
         os.makedirs(self.dir, exist_ok=True)
         # clean torn writes from a previous crash
         for name in os.listdir(self.dir):
@@ -64,7 +77,10 @@ class BootCheckpoint:
                 except OSError:
                     pass
         self._meta_path = os.path.join(self.dir, "meta.json")
-        meta = {"fingerprint": fingerprint, "nboots": nboots, "n_cells": n_cells}
+        meta = {
+            "fingerprint": fingerprint, "nboots": nboots, "n_cells": n_cells,
+            "rows_per_boot": rows_per_boot,
+        }
         if not os.path.exists(self._meta_path):
             with open(self._meta_path, "w") as f:
                 json.dump(meta, f)
@@ -81,7 +97,7 @@ class BootCheckpoint:
                 labels, scores = z["labels"], z["scores"]
         except Exception:
             return None  # torn write: recompute this chunk
-        if labels.shape != (size, self.n_cells):
+        if labels.shape != (size * self.rows_per_boot, self.n_cells):
             return None
         return labels, scores
 
@@ -97,7 +113,7 @@ class BootCheckpoint:
             if _CHUNK_RE.match(name):
                 try:
                     with np.load(os.path.join(self.dir, name)) as z:
-                        done += z["labels"].shape[0]
+                        done += z["labels"].shape[0] // self.rows_per_boot
                 except Exception:
                     pass
         return done

@@ -230,6 +230,71 @@ def test_mesh_fallback_granular_and_indivisible():
     assert _resolve_mesh(cfg.replace(mesh=None), 64) is None
 
 
+class TestDistributedCheckpoint:
+    """VERDICT r3 next #3: kill/resume on the 8-virtual-device mesh for both
+    modes. The boot fan-out runs chunked along the padded boot axis; a rerun
+    resumes at the first missing chunk; results are bit-identical to the
+    fused (no-checkpoint) step."""
+
+    def _setup(self, mode, tmp_path, monkeypatch, nboots=16):
+        from consensusclustr_tpu.utils.log import LevelLog
+
+        monkeypatch.setenv("CCTPU_CKPT_CHUNK", "8")  # 2 chunks at nboots=16
+        x, _ = make_blobs(n_per=24, n_genes=8, n_clusters=2, sep=8.0, seed=13)
+        pca = x[:, :4].astype(np.float32)
+        cfg = ClusterConfig(
+            nboots=nboots, k_num=(5,), res_range=(0.1, 0.5), max_clusters=16,
+            mode=mode, checkpoint_dir=str(tmp_path),
+        )
+        return pca, cfg, root_key(17), LevelLog
+
+    @pytest.mark.parametrize("mode", ["robust", "granular"])
+    def test_kill_resume_bit_identical(self, mode, tmp_path, monkeypatch):
+        import glob
+        import os
+
+        pca, cfg, key, LevelLog = self._setup(mode, tmp_path, monkeypatch)
+        mesh = consensus_mesh(boot=4, cell=2)
+
+        want, _, want_boots = distributed_consensus_cluster(
+            key, pca, cfg.replace(checkpoint_dir=None), mesh
+        )
+        full, _, full_boots = distributed_consensus_cluster(key, pca, cfg, mesh)
+        np.testing.assert_array_equal(full, want)
+        np.testing.assert_array_equal(full_boots, want_boots)
+
+        # simulate a crash that lost the last chunk: resume must recompute
+        # ONLY the missing chunk and reproduce the fused result exactly
+        chunks = sorted(glob.glob(str(tmp_path / "*" / "boots_*.npz")))
+        assert len(chunks) == 2
+        os.unlink(chunks[-1])
+        log = LevelLog()
+        again, _, again_boots = distributed_consensus_cluster(
+            key, pca, cfg, mesh, log=log
+        )
+        np.testing.assert_array_equal(again, want)
+        np.testing.assert_array_equal(again_boots, want_boots)
+        kinds = [r["kind"] for r in log.records]
+        assert kinds.count("boots_resumed") == 1
+        assert kinds.count("boots") == 1
+
+    def test_resume_across_mesh_shapes(self, tmp_path, monkeypatch):
+        """Per-boot labels are bit-identical across mesh shapes, so chunks
+        written on a (boot=8, cell=1) mesh resume on a (boot=2, cell=4) one
+        (same device count -> same fingerprint)."""
+        pca, cfg, key, LevelLog = self._setup("robust", tmp_path, monkeypatch)
+        a, _, _ = distributed_consensus_cluster(
+            key, pca, cfg, consensus_mesh(boot=8, cell=1)
+        )
+        log = LevelLog()
+        b, _, _ = distributed_consensus_cluster(
+            key, pca, cfg, consensus_mesh(boot=2, cell=4), log=log
+        )
+        np.testing.assert_array_equal(a, b)
+        kinds = {r["kind"] for r in log.records}
+        assert "boots_resumed" in kinds and "boots" not in kinds
+
+
 def test_consensus_clust_mesh_granular_bit_identical():
     """Granular mode shards too (SURVEY §2.4 rows 1-2): every (k, res)
     candidate of every boot joins the consensus, bit-identical to the

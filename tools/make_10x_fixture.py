@@ -1,0 +1,67 @@
+"""Generate the committed 10x-format fixture under tests/fixtures/.
+
+The build environment has zero egress, so an actual Cell Ranger download
+cannot be committed; this writes a realistic NB-mixture dataset
+(utils/synth.nb_mixture_counts: gamma base rates, lognormal depth variation,
+geometric population sizes — the same marginal family as real 10x data) in
+the *genuine on-disk 10x format*: gzipped genes x cells MatrixMarket plus
+barcodes.tsv.gz / features.tsv.gz, exactly what `io.load_10x` and Seurat's
+Read10X consume. Ground-truth labels land next to it for the e2e ARI check.
+
+Run from the repo root:  python tools/make_10x_fixture.py
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+
+import numpy as np
+
+from consensusclustr_tpu.utils.synth import nb_mixture_counts
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "pbmc_like_10x",
+)
+
+N_CELLS = 600
+N_GENES = 500
+N_POPS = 4
+SEED = 7
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    counts, truth = nb_mixture_counts(
+        n_cells=N_CELLS, n_genes=N_GENES, n_populations=N_POPS,
+        de_frac=0.12, de_lfc=1.8, seed=SEED,
+    )
+    counts = counts.astype(np.int64)  # 10x matrices are integer counts
+
+    # genes x cells, 1-based, integer — the Cell Ranger mtx layout
+    genes_by_cells = counts.T
+    rows, cols = np.nonzero(genes_by_cells)
+    with gzip.open(os.path.join(OUT, "matrix.mtx.gz"), "wt") as f:
+        f.write("%%MatrixMarket matrix coordinate integer general\n")
+        f.write('%metadata_json: {"software_version": "fixture"}\n')
+        f.write(f"{N_GENES} {N_CELLS} {len(rows)}\n")
+        for i, j in zip(rows, cols):
+            f.write(f"{i + 1} {j + 1} {genes_by_cells[i, j]}\n")
+
+    with gzip.open(os.path.join(OUT, "barcodes.tsv.gz"), "wt") as f:
+        for c in range(N_CELLS):
+            f.write(f"CELL{c:05d}-1\n")
+
+    with gzip.open(os.path.join(OUT, "features.tsv.gz"), "wt") as f:
+        for g in range(N_GENES):
+            f.write(f"FIXT{g:07d}\tGene{g}\tGene Expression\n")
+
+    np.save(os.path.join(OUT, "truth_labels.npy"), truth.astype(np.int32))
+    nnz = len(rows)
+    print(f"wrote {OUT}: {N_GENES}x{N_CELLS} genes x cells, nnz={nnz} "
+          f"(density {nnz / (N_CELLS * N_GENES):.3f})")
+
+
+if __name__ == "__main__":
+    main()

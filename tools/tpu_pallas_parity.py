@@ -42,10 +42,13 @@ def main() -> int:
 
     rng = np.random.default_rng(0)
     shapes = {
-        # (B, n, n_clusters): robust default, granular-ish B, taller n
+        # (B, n, n_clusters): robust default, granular-ish B, taller n,
+        # then the bench workload shape (10k cells) — kept last so the small
+        # grids bank even if the big one trips the tunnel watchdog
         "robust_100x1024": (100, 1024, 24),
         "granular_720x512": (720, 512, 48),
         "tall_32x2048": (32, 2048, 12),
+        "bench_24x10000": (24, 10_000, 64),
     }
     out: dict = {}
     ok = True

@@ -27,9 +27,12 @@ def fetch_bench(fn, *args, reps=3, rtt=0.067):
 
 
 def main():
+    import json
+
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 9000
     n_res = int(sys.argv[2]) if len(sys.argv) > 2 else 12
     print(f"backend={jax.default_backend()} n={n} n_res={n_res}", flush=True)
+    phases = {}
 
     from consensusclustr_tpu.cluster.knn import knn_points
     from consensusclustr_tpu.cluster.leiden import leiden_fixed, _local_moves
@@ -42,9 +45,11 @@ def main():
     res_list = jnp.linspace(0.05, 1.5, n_res)
 
     t = fetch_bench(lambda: knn_points(x, 20))
+    phases["knn_points_ms"] = round(t * 1e3, 1)
     print(f"knn_points:        {t*1e3:8.1f} ms", flush=True)
     idx, _ = knn_points(x, 20)
     t = fetch_bench(lambda: snn_graph(idx))
+    phases["snn_graph_ms"] = round(t * 1e3, 1)
     print(f"snn_graph:         {t*1e3:8.1f} ms", flush=True)
     g = snn_graph(idx)
 
@@ -54,9 +59,11 @@ def main():
         jax.vmap(lambda k, res: _local_moves(k, g, lab0, res, 20))
     )
     t = fetch_bench(lambda: vm_local(keys, res_list))
+    phases["local_moves_ms"] = round(t * 1e3, 1)
     print(f"local_moves x{n_res}:  {t*1e3:8.1f} ms", flush=True)
     vm_leiden = jax.jit(jax.vmap(lambda k, res: leiden_fixed(k, g, res)))
     t = fetch_bench(lambda: vm_leiden(keys, res_list))
+    phases["leiden_sweep_ms"] = round(t * 1e3, 1)
     print(f"leiden full x{n_res}:  {t*1e3:8.1f} ms", flush=True)
 
     grid = jax.jit(
@@ -65,7 +72,13 @@ def main():
         )
     )
     t = fetch_bench(grid, reps=2)
+    phases["cluster_grid_ms"] = round(t * 1e3, 1)
     print(f"cluster_grid k=3:  {t*1e3:8.1f} ms  ({t:.2f} s/boot)", flush=True)
+    print(json.dumps({
+        "perf_probe": phases, "backend": jax.default_backend(),
+        "cells": n, "n_res": n_res,
+        "boots_per_sec_grid_only": round(1.0 / max(t, 1e-9), 3),
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -453,9 +453,29 @@ def _level(
         counts_for_glm = (
             jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None
         )
+        sf_glm = sf
+        if (
+            sf_glm is None
+            and counts_for_glm is not None
+            and cfg.regress_method in ("glmGamPoi", "poisson")
+        ):
+            # norm was supplied pre-normalised, so no size factors were
+            # computed this level; the GLM paths still need a depth offset
+            # (docs/quirks.md D9) — derive library-size factors.
+            if sparse_counts:
+                from consensusclustr_tpu.prep.sparse import (
+                    compute_size_factors_sparse,
+                )
+
+                sf_glm = jnp.asarray(
+                    compute_size_factors_sparse(ing.counts, "libsize")
+                )
+            else:
+                sf_glm = compute_size_factors(counts_dev, "libsize")
         norm = regress_features(
             norm, jnp.asarray(ing.covariates, jnp.float32),
             counts=counts_for_glm, method=cfg.regress_method,
+            size_factors=sf_glm,
         )
         log.event("regressed", method=cfg.regress_method)
 

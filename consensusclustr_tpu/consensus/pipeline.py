@@ -177,7 +177,12 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
                 "mode": cfg.mode,
                 "nboots": cfg.nboots, "boot_size": cfg.boot_size,
                 "k_num": list(k_list), "res_range": list(cfg.res_range),
-                "max_clusters": cfg.max_clusters, "chunk": chunk,
+                # Chunk size is deliberately NOT hashed: per-boot labels are
+                # chunk-size-invariant, and load_chunk validates each chunk's
+                # row count, so a resume under a different CCTPU_MAX_CHUNK /
+                # platform budget reuses whatever aligned chunks exist instead
+                # of orphaning the whole run (ADVICE r4).
+                "max_clusters": cfg.max_clusters,
                 # anything _boot_batch's output depends on must be hashed, or
                 # a resume silently reuses chunks from a different algorithm
                 "cluster_fun": cfg.cluster_fun,

@@ -159,9 +159,21 @@ def _read_tsv_rows(path: str) -> list:
 
 def _read_tsv_column(path: str, column: int = 0) -> np.ndarray:
     rows = _read_tsv_rows(path)
-    return np.asarray(
-        [r[min(column, len(r) - 1)] for r in rows], dtype=object
-    )
+    if not rows:
+        return np.asarray([], dtype=object)
+    # Decide the column once per file, from the WIDEST row: clamping per row
+    # would silently mix id and symbol columns when a features file has
+    # occasional short rows (ADVICE r4) — and clamping to the first row
+    # would do the same file-wide whenever the first row happens to be the
+    # truncated one. Any row too short for the chosen column is an error.
+    col = min(column, max(len(r) for r in rows) - 1)
+    short = [i for i, r in enumerate(rows) if len(r) <= col]
+    if short:
+        raise ValueError(
+            f"{path!r}: rows {short[:5]} have fewer than {col + 1} columns "
+            f"(file-wide column {col} chosen from the widest row)"
+        )
+    return np.asarray([r[col] for r in rows], dtype=object)
 
 
 def load_10x(directory: str) -> CountMatrix:
@@ -190,14 +202,30 @@ def load_10x(directory: str) -> CountMatrix:
         raise FileNotFoundError(f"no matrix.mtx[.gz] in {directory!r}")
     cm = load_counts(mtx, transpose=True)  # 10x ships genes x cells
 
+    # A sidecar whose row count disagrees with the matrix is a truncated or
+    # mismatched file; Seurat's Read10X errors on this. We keep loading (the
+    # counts themselves are intact) but warn loudly instead of silently
+    # dropping the names (ADVICE r4).
+    import warnings
+
     barcodes = _find("barcodes.tsv")
     if barcodes is not None:
         names = _read_tsv_column(barcodes)
         if len(names) == cm.shape[0]:
             cm.cell_names = names
+        else:
+            warnings.warn(
+                f"{barcodes!r} has {len(names)} rows but the matrix has "
+                f"{cm.shape[0]} cells; ignoring cell names", stacklevel=2
+            )
     features = _find("features.tsv", "genes.tsv")
     if features is not None:
         names = _read_tsv_column(features, column=1)
         if len(names) == cm.shape[1]:
             cm.gene_names = names
+        else:
+            warnings.warn(
+                f"{features!r} has {len(names)} rows but the matrix has "
+                f"{cm.shape[1]} genes; ignoring gene names", stacklevel=2
+            )
     return cm

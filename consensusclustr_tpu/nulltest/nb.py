@@ -49,9 +49,31 @@ def fit_nb(counts: jax.Array, n_iters: int = 30):
     """
     x = jnp.asarray(counts, jnp.float32)
     mu = jnp.maximum(jnp.mean(x, axis=0), 1e-8)
-    var = jnp.var(x, axis=0)
-    overdisp = var - mu
-    eta0 = jnp.log(jnp.clip(mu * mu / jnp.maximum(overdisp, 1e-8), THETA_MIN, THETA_MAX))
+    # The intercept-only model is the degenerate regression case: a constant
+    # per-cell mean. Under mu = sample mean, fit_theta_given_mu's moments
+    # init and Poisson-limit fallback reduce exactly to the var-vs-mean ones.
+    theta = fit_theta_given_mu(x, jnp.broadcast_to(mu[None, :], x.shape), n_iters=n_iters)
+    return mu, theta
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters",))
+def fit_theta_given_mu(counts: jax.Array, mu: jax.Array, n_iters: int = 30) -> jax.Array:
+    """Per-gene NB theta MLE with a fixed per-cell mean matrix.
+
+    counts, mu: [n_cells, n_genes]. Returns theta [G] float32.
+
+    The regression case of `fit_nb`: mu varies per cell (fitted by a GLM,
+    reference R/consensusClust.R:846-856) instead of being the intercept-only
+    sample mean. Same clamped Newton on eta = log(theta) — `_nb_loglik`
+    broadcasts a per-cell mu vector unchanged — initialised at the
+    method-of-moments estimate from the excess variance over the fitted means.
+    Genes with no overdispersion signal fall back to the Poisson limit.
+    """
+    x = jnp.asarray(counts, jnp.float32)
+    mu = jnp.maximum(jnp.asarray(mu, jnp.float32), 1e-8)
+    excess = jnp.mean((x - mu) ** 2 - mu, axis=0)
+    mu2 = jnp.mean(mu * mu, axis=0)
+    eta0 = jnp.log(jnp.clip(mu2 / jnp.maximum(excess, 1e-8), THETA_MIN, THETA_MAX))
 
     grad = jax.grad(_nb_loglik)
     hess = jax.grad(grad)
@@ -60,19 +82,15 @@ def fit_nb(counts: jax.Array, n_iters: int = 30):
         def body(_, e):
             g = grad(e, xg, mug)
             h = hess(e, xg, mug)
-            # Newton when concave; clipped gradient ascent otherwise.
             step = jnp.where(h < -1e-8, -g / h, jnp.sign(g) * 0.5)
             step = jnp.clip(step, -2.0, 2.0)
-            e = e + step
-            return jnp.clip(e, jnp.log(THETA_MIN), jnp.log(THETA_MAX))
+            return jnp.clip(e + step, jnp.log(THETA_MIN), jnp.log(THETA_MAX))
 
         return jax.lax.fori_loop(0, n_iters, body, eta)
 
-    eta = jax.vmap(one_gene, in_axes=(0, 1, 0))(eta0, x, mu)
-    # Poisson-limit fallback for genes with no overdispersion signal: the
-    # likelihood in theta is flat/increasing, send theta to the cap.
-    eta = jnp.where(overdisp <= 0.0, jnp.log(THETA_MAX), eta)
-    return mu, jnp.exp(eta)
+    eta = jax.vmap(one_gene, in_axes=(0, 1, 1))(eta0, x, mu)
+    eta = jnp.where(excess <= 0.0, jnp.log(THETA_MAX), eta)
+    return jnp.exp(eta)
 
 
 def nb_cdf(k: jax.Array, mu: jax.Array, theta: jax.Array) -> jax.Array:

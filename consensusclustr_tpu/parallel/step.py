@@ -322,10 +322,12 @@ def _checkpointed_distributed_run(
     checkpoints the flattened candidate axis (|k|*|res| rows per boot).
 
     The fingerprint hashes every determinant of a chunk's content — including
-    b_pad (device-count-derived) and the chunk size — but NOT the mesh layout
-    itself: per-boot labels are bit-identical across mesh shapes (the
-    determinism contract), so a (boot=8, cell=1) run may resume chunks written
-    by a (boot=2, cell=4) run on the same 8 devices."""
+    b_pad (device-count-derived) — but NOT the mesh layout (per-boot labels
+    are bit-identical across mesh shapes, the determinism contract, so a
+    (boot=8, cell=1) run may resume chunks written by a (boot=2, cell=4) run
+    on the same 8 devices) and NOT the chunk size (chunks are shape-validated
+    on load, so changing CCTPU_CKPT_CHUNK between runs reuses aligned chunks
+    rather than orphaning them all)."""
     from consensusclustr_tpu.parallel.mesh import BOOT_AXIS as _BA, CELL_AXIS as _CA
     from consensusclustr_tpu.utils.checkpoint import (
         BootCheckpoint,
@@ -344,7 +346,10 @@ def _checkpointed_distributed_run(
             "distributed": True, "mode": cfg.mode,
             "nboots": cfg.nboots, "b_pad": b_pad, "boot_size": cfg.boot_size,
             "k_num": list(k_list), "res_range": [float(r) for r in cfg.res_range],
-            "max_clusters": cfg.max_clusters, "chunk": chunk_boots,
+            # chunk size deliberately not hashed: chunks are validated by
+            # shape on load, so a resume under a different CCTPU_CKPT_CHUNK
+            # reuses aligned chunks instead of orphaning the run (ADVICE r4)
+            "max_clusters": cfg.max_clusters,
             "cluster_fun": cfg.cluster_fun, "compute_dtype": cfg.compute_dtype,
             "n_iters": DEFAULT_COMMUNITY_ITERS,
         },

@@ -7,8 +7,12 @@ Equivalent of the reference's `regressFeatures`
     `qr.resid` per gene over chunked nested bplapply (:827-844). Here the whole
     thing is a single batched matmul: resid = X - Q (Q^T X).
   * "glmGamPoi": Pearson residuals of a gamma-Poisson GLM on the raw counts
-    (:846-856). Here: vmapped fixed-iteration IRLS Poisson fit per gene plus a
-    method-of-moments overdispersion, then NB Pearson residuals.
+    (:846-856). Here a real Gamma-Poisson alternation, all vmapped over genes:
+    Poisson IRLS warm start -> per-gene theta MLE (Newton on log-theta,
+    `nulltest.nb.fit_theta_given_mu`) -> NB-weighted IRLS re-fit of the means
+    -> theta re-fit, then NB Pearson residuals. That alternating
+    beta-given-theta / theta-given-mu scheme is the same estimation structure
+    glmGamPoi itself uses, not a moments shortcut.
   * "poisson": per-gene Poisson GLM Pearson residuals. The reference's branch
     is broken (:858-880, see SURVEY §8.2 item 9); we implement the intent.
 
@@ -45,44 +49,95 @@ def lm_residuals(x: jax.Array, covariates: jax.Array) -> jax.Array:
     return x - q @ (q.T @ x)
 
 
-@functools.partial(jax.jit, static_argnames=("n_iters", "family"))
-def _glm_pearson_residuals(
-    counts: jax.Array, covariates: jax.Array, n_iters: int = 8, family: str = "nb"
-) -> jax.Array:
-    """Per-gene Poisson IRLS fit (log link) on raw counts, vmapped over genes;
-    Pearson residuals under Poisson or NB (moments theta) variance."""
-    y_all = jnp.asarray(counts, jnp.float32)  # [n, g]
-    d = _design(covariates)                   # [n, q]
+@functools.partial(jax.jit, static_argnames=("n_iters",))
+def _irls_fit(
+    y_all: jax.Array,
+    d: jax.Array,
+    inv_theta: jax.Array,
+    beta0_all: jax.Array,
+    offset: jax.Array,
+    n_iters: int = 8,
+):
+    """Per-gene log-link IRLS, vmapped over genes. Returns (mu [n,g], beta [q,g]).
+
+    inv_theta [g] sets the working weights w = mu / (1 + mu/theta):
+    inv_theta=0 is the Poisson GLM, inv_theta>0 the NB GLM at fixed theta.
+    beta0_all [q, g] is the starting point (the NB pass warm-starts from the
+    Poisson pass's betas). offset [n] enters the linear predictor unpenalised
+    (eta = offset + D beta) — the log-size-factor term that keeps per-cell
+    depth out of the residuals. beta <- solve(D^T W D, D^T W z - offset term),
+    fixed iteration count for jit.
+    """
     q = d.shape[1]
 
-    def fit_gene(y):
-        # IRLS for Poisson log link: beta <- solve(D^T W D, D^T W z)
-        mean0 = jnp.maximum(jnp.mean(y), 1e-8)
-        beta0 = jnp.zeros((q,), jnp.float32).at[0].set(jnp.log(mean0))
-
+    def fit_gene(y, it, beta0):
         def step(beta, _):
-            eta = jnp.clip(d @ beta, -30.0, 30.0)
+            eta = jnp.clip(offset + d @ beta, -30.0, 30.0)
             mu = jnp.exp(eta)
-            w = mu  # Poisson working weights
-            z = eta + (y - mu) / jnp.maximum(mu, 1e-8)
+            w = mu / (1.0 + it * mu)
+            z = eta + (y - mu) / jnp.maximum(mu, 1e-8) - offset
             dtw = d.T * w[None, :]
             h = dtw @ d + 1e-6 * jnp.eye(q, dtype=jnp.float32)
             beta_new = jnp.linalg.solve(h, dtw @ z)
             return beta_new, None
 
         beta, _ = jax.lax.scan(step, beta0, None, length=n_iters)
-        mu = jnp.exp(jnp.clip(d @ beta, -30.0, 30.0))
-        return mu
+        return jnp.exp(jnp.clip(offset + d @ beta, -30.0, 30.0)), beta
 
-    mu_all = jax.vmap(fit_gene, in_axes=1, out_axes=1)(y_all)  # [n, g]
-    mu_all = jnp.maximum(mu_all, 1e-8)
+    mu_all, beta_all = jax.vmap(fit_gene, in_axes=(1, 0, 1), out_axes=(1, 1))(
+        y_all, inv_theta, beta0_all
+    )
+    return jnp.maximum(mu_all, 1e-8), beta_all
 
+
+def _glm_pearson_residuals(
+    counts: jax.Array,
+    covariates: jax.Array,
+    n_iters: int = 8,
+    family: str = "nb",
+    size_factors: jax.Array = None,
+) -> jax.Array:
+    """Per-gene GLM Pearson residuals on raw counts (log link).
+
+    family="poisson": one Poisson IRLS pass, residuals under Var = mu.
+    family="nb": Gamma-Poisson alternation — Poisson IRLS warm start, theta
+    MLE given mu (`fit_theta_given_mu`), NB-weighted IRLS re-fit of beta at
+    that theta, theta re-fit at the final means — residuals under
+    Var = mu + mu^2/theta. Matches the estimation structure of glmGamPoi
+    (reference R/consensusClust.R:846-856) rather than a moments shortcut.
+
+    size_factors [n] (when given) become a log offset in the linear
+    predictor. The reference reaches depth-invariance differently — it feeds
+    already-normalised values into glm_gp with `size_factors = 1, offset = 0`
+    (:850-856) — but on raw counts the offset is the statistically sound way
+    to keep per-cell depth out of the residuals; without it, depth is the
+    dominant correlation across genes and drowns the population signal
+    downstream (docs/quirks.md D9).
+    """
+    from consensusclustr_tpu.nulltest.nb import fit_theta_given_mu
+
+    y_all = jnp.asarray(counts, jnp.float32)  # [n, g]
+    d = _design(covariates)                   # [n, q]
+    n, g = y_all.shape
+    q = d.shape[1]
+    if size_factors is None:
+        offset = jnp.zeros((n,), jnp.float32)
+    else:
+        offset = jnp.log(jnp.maximum(jnp.asarray(size_factors, jnp.float32), 1e-8))
+
+    # Intercept-at-log-mean start for the Poisson pass (offset-adjusted).
+    beta0 = jnp.zeros((q, g), jnp.float32).at[0, :].set(
+        jnp.log(jnp.maximum(jnp.mean(y_all, axis=0), 1e-8))
+        - jnp.mean(offset)
+    )
+    mu_all, beta = _irls_fit(
+        y_all, d, jnp.zeros((g,), jnp.float32), beta0, offset, n_iters=n_iters
+    )
     if family == "nb":
-        # Method-of-moments overdispersion per gene: Var = mu + mu^2/theta.
-        excess = jnp.mean((y_all - mu_all) ** 2 - mu_all, axis=0)
-        mu2 = jnp.mean(mu_all**2, axis=0)
-        inv_theta = jnp.clip(excess / jnp.maximum(mu2, 1e-8), 0.0, 1e6)
-        var = mu_all + inv_theta[None, :] * mu_all**2
+        theta = fit_theta_given_mu(y_all, mu_all)
+        mu_all, _ = _irls_fit(y_all, d, 1.0 / theta, beta, offset, n_iters=4)
+        theta = fit_theta_given_mu(y_all, mu_all)
+        var = mu_all + mu_all**2 / theta[None, :]
     else:
         var = mu_all
     return (y_all - mu_all) / jnp.sqrt(var)
@@ -93,11 +148,14 @@ def regress_features(
     covariates: jax.Array,
     counts: jax.Array = None,
     method: str = "lm",
+    size_factors: jax.Array = None,
 ) -> jax.Array:
     """Dispatch mirroring regressFeatures(method=...) (reference :824-880).
 
     norm_counts: [n_cells, n_genes] shifted-log values ("lm" path input).
-    counts: raw counts, required for the GLM paths.
+    counts: raw counts, required for the GLM paths. size_factors [n]: log
+    offset for the GLM paths (depth-invariant residuals; see
+    `_glm_pearson_residuals`).
     Returns the residualised expression matrix used downstream in place of
     norm_counts.
     """
@@ -106,9 +164,13 @@ def regress_features(
     if method == "glmGamPoi":
         if counts is None:
             raise ValueError("glmGamPoi regression needs raw counts")
-        return _glm_pearson_residuals(counts, covariates, family="nb")
+        return _glm_pearson_residuals(
+            counts, covariates, family="nb", size_factors=size_factors
+        )
     if method == "poisson":
         if counts is None:
             raise ValueError("poisson regression needs raw counts")
-        return _glm_pearson_residuals(counts, covariates, family="poisson")
+        return _glm_pearson_residuals(
+            counts, covariates, family="poisson", size_factors=size_factors
+        )
     raise ValueError(f"unknown regress method {method!r}")

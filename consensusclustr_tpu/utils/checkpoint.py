@@ -99,6 +99,11 @@ class BootCheckpoint:
             return None  # torn write: recompute this chunk
         if labels.shape != (size * self.rows_per_boot, self.n_cells):
             return None
+        # scores must be per-row too: a malformed-but-loadable scores array
+        # would otherwise crash the granular resume reshape downstream
+        # instead of falling back to recompute (ADVICE r4).
+        if scores.shape != (size * self.rows_per_boot,):
+            return None
         return labels, scores
 
     def save_chunk(self, start: int, labels: np.ndarray, scores: np.ndarray) -> None:
@@ -108,12 +113,19 @@ class BootCheckpoint:
         os.replace(tmp, path)
 
     def completed_boots(self) -> int:
-        done = 0
+        # Count DISTINCT covered boot indices, not file row totals: since
+        # chunk size left the fingerprint (ADVICE r4), a resume under a
+        # different chunking can leave stale overlapping files behind, and
+        # summing rows would double-count the overlap.
+        covered = np.zeros(max(self.nboots, 1), bool)
         for name in sorted(os.listdir(self.dir)):
-            if _CHUNK_RE.match(name):
+            m = _CHUNK_RE.match(name)
+            if m:
                 try:
+                    start = int(m.group(1))
                     with np.load(os.path.join(self.dir, name)) as z:
-                        done += z["labels"].shape[0] // self.rows_per_boot
+                        k = z["labels"].shape[0] // self.rows_per_boot
+                    covered[start:start + k] = True
                 except Exception:
                     pass
-        return done
+        return int(covered.sum())

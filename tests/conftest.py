@@ -25,6 +25,10 @@ assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running statistical test")
+    config.addinivalue_line(
+        "markers",
+        "smoke: curated <2-min cross-layer subset (python -m pytest -m smoke)",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -27,6 +27,7 @@ from tests.conftest import make_blobs
 
 # ---------- kNN ----------
 
+@pytest.mark.smoke
 def test_knn_matches_bruteforce_numpy(rng):
     x = rng.normal(size=(50, 4)).astype(np.float32)
     idx, dist = knn_points(x, 5)
@@ -51,6 +52,7 @@ def test_knn_from_distance_matrix(rng):
 
 # ---------- SNN ----------
 
+@pytest.mark.smoke
 def test_snn_rank_weights_small_case():
     # 4 points on a line: 0-1 close, 2-3 close, pairs far apart
     x = np.array([[0.0], [0.1], [10.0], [10.1]], np.float32)
@@ -95,6 +97,7 @@ def _two_clique_graph():
     return x
 
 
+@pytest.mark.smoke
 def test_leiden_recovers_planted_blobs():
     x, truth = make_blobs(n_per=50, n_genes=8, n_clusters=3, sep=8.0, seed=2)
     idx, _ = knn_points(jnp.asarray(x), 10)

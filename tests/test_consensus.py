@@ -22,6 +22,7 @@ from consensusclustr_tpu.utils.rng import root_key
 from tests.conftest import make_blobs
 
 
+@pytest.mark.smoke
 def test_bootstrap_indices_deterministic_and_in_range():
     k = root_key(7)
     idx1 = np.asarray(bootstrap_indices(k, 100, 5, 90))
@@ -41,6 +42,7 @@ def test_sampled_mask_matches_indices():
     )
 
 
+@pytest.mark.smoke
 def test_coclustering_distance_oracle():
     # hand-checkable case + full numpy oracle
     labels = np.array(

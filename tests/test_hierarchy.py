@@ -1,6 +1,7 @@
 """hierarchy/: determineHierachy parity + dendrogram cut/walk + clustree table."""
 
 import numpy as np
+import pytest
 
 from consensusclustr_tpu.hierarchy import (
     cluster_distance_matrix,
@@ -29,6 +30,7 @@ def test_cluster_distance_matrix_is_mean_linkage():
     assert np.all(np.diag(cmat) == 0)
 
 
+@pytest.mark.smoke
 def test_determine_hierarchy_topology():
     d, labels = _three_group_dist()
     dend = determine_hierarchy(d, labels)

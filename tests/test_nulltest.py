@@ -29,6 +29,7 @@ MU, THETA = 5.0, 2.0
 P = THETA / (THETA + MU)
 
 
+@pytest.mark.smoke
 def test_fit_nb_recovers_parameters():
     r = np.random.default_rng(0)
     x = r.negative_binomial(THETA, P, size=(3000, 6)).astype(np.float32)
@@ -48,6 +49,7 @@ def test_fit_nb_poisson_limit():
     np.testing.assert_allclose(np.asarray(mu), 4.0, rtol=0.15)
 
 
+@pytest.mark.smoke
 def test_nb_cdf_and_quantile_match_scipy():
     k = np.arange(0, 30, dtype=np.float32)
     ours = np.asarray(nb_cdf(jnp.asarray(k), jnp.float32(MU), jnp.float32(THETA)))

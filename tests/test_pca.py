@@ -1,6 +1,7 @@
 """Truncated-PCA parity with dense SVD oracles (SURVEY §4 item 1)."""
 
 import numpy as np
+import pytest
 
 from consensusclustr_tpu.linalg import truncated_pca, choose_pc_num, pca_for_config
 
@@ -36,6 +37,7 @@ def _assert_component_match(got, exp, cos_tol=0.999):
         np.testing.assert_allclose(np.linalg.norm(ge), np.linalg.norm(ee), rtol=5e-3)
 
 
+@pytest.mark.smoke
 def test_scores_match_dense_svd(rng):
     x = _low_rank(rng).astype(np.float32)
     res = truncated_pca(x, 5, center=True, scale=False)
@@ -71,6 +73,7 @@ def test_scale_gated_on_scale_param(rng):
     assert load_scaled < 0.75   # scaled: big gene no longer dominates
 
 
+@pytest.mark.smoke
 def test_choose_pc_num_rule():
     sdev = np.array([5.0, 3.0, 2.0] + [0.1] * 47)
     # cumfrac after 1 PC: 5/14.7=0.34 > 0.2 → k=1 → floored to 5

@@ -19,6 +19,7 @@ from consensusclustr_tpu.prep import (
 )
 
 
+@pytest.mark.smoke
 def test_libsize_factors_unit_mean(rng):
     counts = rng.poisson(3.0, size=(50, 30)).astype(np.float32)
     sf = np.asarray(libsize_factors(counts))
@@ -40,6 +41,7 @@ def test_stabilize_geometric_mean_and_repair():
     np.testing.assert_allclose(good[1] / good[0], 4.0, rtol=1e-5)
 
 
+@pytest.mark.smoke
 def test_shifted_log_matches_closed_form(rng):
     counts = rng.poisson(4.0, size=(20, 10)).astype(np.float32)
     sf = rng.uniform(0.5, 2.0, size=20).astype(np.float32)
@@ -118,6 +120,7 @@ def test_poisson_deviance_nonnegative(rng):
     assert np.all(dev >= -1e-3)
 
 
+@pytest.mark.smoke
 def test_lm_residuals_match_numpy_lstsq(rng):
     x = rng.normal(size=(40, 6)).astype(np.float32)
     cov = rng.normal(size=(40, 2)).astype(np.float32)
@@ -142,6 +145,90 @@ def test_glm_pearson_residuals_remove_covariate_effect():
         assert abs(np.corrcoef(resid[:, gi], cov[:, 0])[0, 1]) < 0.1
     raw_corr = abs(np.corrcoef(counts[:, 0], cov[:, 0])[0, 1])
     assert raw_corr > 0.4  # sanity: effect existed before regression
+
+
+def test_glmgampoi_is_a_real_gamma_poisson_fit():
+    """On overdispersed NB data with a known covariate effect, glmGamPoi and
+    poisson residuals must measurably differ (VERDICT r4 weak #3): under the
+    correct NB variance the Pearson residual variance is ~1, while the
+    Poisson-variance residuals blow up by the overdispersion factor.
+    Workload per reference R/consensusClust.R:846-856."""
+    r = np.random.default_rng(11)
+    n, g, theta = 500, 8, 0.5
+    cov = r.normal(size=(n, 1)).astype(np.float32)
+    mu = np.exp(1.5 + 0.7 * cov[:, 0])[:, None] * np.ones((1, g))
+    lam = r.gamma(shape=theta, scale=mu / theta)
+    counts = r.poisson(lam).astype(np.float32)
+
+    nb_resid = np.asarray(
+        regress_features(None, cov, counts=counts, method="glmGamPoi")
+    )
+    po_resid = np.asarray(
+        regress_features(None, cov, counts=counts, method="poisson")
+    )
+
+    nb_var = nb_resid.var(axis=0)
+    po_var = po_resid.var(axis=0)
+    # NB Pearson residuals are ~unit variance under the true model...
+    assert np.all(nb_var > 0.6) and np.all(nb_var < 1.6), nb_var
+    # ...while Poisson-variance residuals inflate by E[1 + mu/theta] >> 1.
+    assert np.all(po_var > 2.5 * nb_var), (po_var, nb_var)
+    # Both still remove the covariate effect.
+    for gi in range(g):
+        assert abs(np.corrcoef(nb_resid[:, gi], cov[:, 0])[0, 1]) < 0.15
+
+
+def test_glm_residuals_depth_offset_preserves_population_signal():
+    """docs/quirks.md D9: with per-cell depth variation, the GLM paths must
+    take size factors as a log offset — otherwise depth is the dominant
+    cross-gene correlation and the residual PCA splits on depth, not
+    population (the failure that collapsed e2e glmGamPoi runs to 1 cluster)."""
+    r = np.random.default_rng(2)
+    n, g = 400, 120
+    lam = r.gamma(2.0, 2.0, size=g)
+    lam2 = lam.copy()
+    lam2[:20] *= 6.0
+    depth = r.uniform(0.5, 2.0, size=n)
+    truth = (np.arange(n) < n // 2).astype(int)
+    mean = np.where(truth[:, None] == 1, lam, lam2) * depth[:, None]
+    counts = r.poisson(mean).astype(np.float32)
+    sf = depth / depth.mean()
+
+    resid = np.asarray(
+        regress_features(
+            None, np.zeros((n, 1), np.float32), counts=counts,
+            method="glmGamPoi", size_factors=sf,
+        )
+    )
+    # residuals must separate the populations linearly: project on the
+    # top principal axis of the class means (LDA-lite via centroid diff)
+    centroid_axis = resid[truth == 1].mean(0) - resid[truth == 0].mean(0)
+    proj = resid @ centroid_axis
+    split = proj > np.median(proj)
+    acc = max((split == truth).mean(), (split != truth).mean())
+    assert acc > 0.95, acc
+    # and per-cell residual depth correlation must be gone
+    row_mean = resid.mean(axis=1)
+    assert abs(np.corrcoef(row_mean, depth)[0, 1]) < 0.25
+
+
+def test_fit_theta_given_mu_recovers_theta_with_varying_means():
+    """The regression-case theta solver (nulltest.nb.fit_theta_given_mu) must
+    recover theta when mu varies per cell — the intercept-only fit_nb cannot
+    represent this case."""
+    from consensusclustr_tpu.nulltest.nb import fit_theta_given_mu
+
+    r = np.random.default_rng(7)
+    n, g = 2000, 6
+    true_theta = np.array([0.3, 0.7, 1.5, 3.0, 8.0, 20.0], np.float32)
+    depth = np.exp(r.normal(0.0, 0.6, size=n)).astype(np.float32)
+    mu = depth[:, None] * np.linspace(2.0, 6.0, g)[None, :]
+    lam = r.gamma(shape=true_theta[None, :], scale=mu / true_theta[None, :])
+    counts = r.poisson(lam).astype(np.float32)
+
+    theta_hat = np.asarray(fit_theta_given_mu(counts, mu))
+    ratio = theta_hat / true_theta
+    assert np.all(ratio > 0.6) and np.all(ratio < 1.7), theta_hat
 
 
 def test_normalize_counts_pipeline(rng):

@@ -1,0 +1,106 @@
+"""The DCN leg, exercised for real: two OS processes, one jax.distributed
+cluster, one cross-process collective (VERDICT r4 §5 distributed row — the
+only 'partial' component: `ensure_distributed`'s positive path had never run).
+
+A real multi-host TPU pod is not available here, but jax's distributed
+runtime is backend-agnostic: two local processes with 4 virtual CPU devices
+each form a genuine 2-process / 8-global-device cluster over a localhost
+coordinator — the same initialize -> global-mesh -> collective layering that
+spans DCN on a pod (parallel/multihost.py docstring). The worker builds
+`consensus_mesh` over the GLOBAL device list and psums a per-process value
+across the "boot" axis, so the assertion fails unless cross-process traffic
+actually happened.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.environ["CCTPU_REPO"])
+from consensusclustr_tpu.parallel.multihost import ensure_distributed, process_info
+from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, consensus_mesh
+
+pid = int(sys.argv[1])
+ok = ensure_distributed(
+    coordinator_address=os.environ["CCTPU_COORD"], num_processes=2, process_id=pid
+)
+assert ok, "ensure_distributed returned False with explicit args"
+info = process_info()
+assert info["process_count"] == 2, info
+assert info["global_devices"] == 8, info
+assert info["local_devices"] == 4, info
+
+mesh = consensus_mesh(boot=8, cell=1)  # all-boot over the global devices
+from jax.experimental.shard_map import shard_map
+
+@jax.jit
+def allsum(x):
+    return shard_map(
+        lambda v: jax.lax.psum(v, BOOT_AXIS),
+        mesh=mesh,
+        in_specs=P(BOOT_AXIS),
+        out_specs=P(),
+    )(x)
+
+# each global device contributes its global index; every process must see
+# the full-cluster sum, which cannot be formed from local devices alone
+x = jax.device_put(
+    jnp.arange(8, dtype=jnp.float32),
+    NamedSharding(mesh, P(BOOT_AXIS)),
+)
+total = float(np.asarray(jax.device_get(allsum(x))))
+assert total == 28.0, total
+print(f"WORKER{pid}_OK total={total} procs={info['process_count']}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_distributed_psum(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{port.getsockname()[1]}"
+    port.close()
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        CCTPU_COORD=coord,
+        CCTPU_REPO=repo,
+    )
+    # a fresh env per worker: the parent conftest's 8-device flag must not
+    # leak (workers want 4 local devices each)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i}_OK total=28.0" in out, out

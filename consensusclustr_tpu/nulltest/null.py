@@ -100,7 +100,7 @@ def generate_null_statistics(
     covariates: Optional[np.ndarray] = None,
     max_clusters: int = 64,
     round_id: int = 0,
-    chunk: int = 4,
+    chunk: Optional[int] = None,
     cluster_fun: str = "leiden",
     res_range=None,
     compute_dtype: str = "float32",
@@ -113,7 +113,20 @@ def generate_null_statistics(
     `res_range=None` keeps the reference's hardcoded null sweep
     (R/consensusClust.R:803); a sequence overrides it (the knob testSplits'
     shadowed resRange argument was presumably meant to be, :892).
+
+    `chunk=None` auto-sizes the vmapped sim batch: 4 for small problems, 1
+    above 16384 cells — a large-n sim is bandwidth-bound so vmap adds no
+    throughput, but it multiplies the XLA program (measured: the 50k-cell
+    chunk-4 compile ran 6m34s on CPU, which on the tunneled TPU would blow
+    the ~2-min per-call watchdog that kills the worker; docs/perf.md).
+    Keys are per-sim, but individual draws are NOT bit-stable across chunk
+    sizes: vmap changes reduction lowering, float rounding shifts, and the
+    discrete clustering inside a draw can flip — only the null DISTRIBUTION
+    is chunk-independent. Reproducibility holds for a fixed (key, n, chunk
+    policy), which auto-chunk keeps deterministic in n.
     """
+    if chunk is None:
+        chunk = 1 if n_cells > 16384 else 4
     res_list = jnp.asarray(
         NULL_SIM_RES_RANGE if res_range is None else list(res_range), jnp.float32
     )

@@ -104,6 +104,7 @@ def generate_null_statistics(
     cluster_fun: str = "leiden",
     res_range=None,
     compute_dtype: str = "float32",
+    log=None,
 ) -> np.ndarray:
     """n_sims null silhouettes, chunk-vmapped on device.
 
@@ -151,4 +152,7 @@ def generate_null_statistics(
                 )
             )
         )
+        if log:
+            # hours-scale at big n: observability for long runs
+            log.event("null_sims", done=e, total=n_sims, round_id=round_id)
     return np.concatenate(out)

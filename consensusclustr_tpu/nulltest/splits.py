@@ -92,7 +92,7 @@ def _clustering_rejected(
 
     stats = generate_null_statistics(
         key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-        covariates=covariates, max_clusters=max_clusters, round_id=0,
+        covariates=covariates, max_clusters=max_clusters, round_id=0, log=log,
         cluster_fun=cluster_fun, res_range=res_range,
         compute_dtype=compute_dtype,
     )
@@ -104,7 +104,7 @@ def _clustering_rejected(
             stats,
             generate_null_statistics(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-                covariates=covariates, max_clusters=max_clusters, round_id=1,
+                covariates=covariates, max_clusters=max_clusters, round_id=1, log=log,
                 cluster_fun=cluster_fun, res_range=res_range,
                 compute_dtype=compute_dtype,
             ),
@@ -115,7 +115,7 @@ def _clustering_rejected(
             stats,
             generate_null_statistics(
                 key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-                covariates=covariates, max_clusters=max_clusters, round_id=2,
+                covariates=covariates, max_clusters=max_clusters, round_id=2, log=log,
                 cluster_fun=cluster_fun, res_range=res_range,
                 compute_dtype=compute_dtype,
             ),

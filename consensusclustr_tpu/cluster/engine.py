@@ -138,18 +138,24 @@ def cluster_grid(
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set.
 
-    The kNN/SNN graph is built once per k (it does not depend on resolution);
-    Leiden/Louvain is vmapped over the resolution axis — the reference instead
-    runs 6000 sequential igraph calls per level (SURVEY §3.1 hot loop #1).
+    The kNN distance pass — the dominant per-boot FLOP cost at scale (the
+    [m, m] MXU matmul + top_k) — runs ONCE at max(k_list): top-k neighbour
+    lists are prefix-nested (lax.top_k is deterministic with ties to the
+    lower index, and the degenerate-n padding repeats the same last true
+    column), so idx_kmax[:, :k] is bit-identical to a direct k-NN call
+    (asserted in tests/test_cluster.py). The SNN graph is then built once
+    per k (it does not depend on resolution); Leiden/Louvain is vmapped over
+    the resolution axis — the reference instead runs 6000 sequential igraph
+    calls per level (SURVEY §3.1 hot loop #1).
     """
     x = jnp.asarray(x, jnp.float32)
     res_list = jnp.asarray(res_list, jnp.float32)
     r = res_list.shape[0]
 
+    idx_max, _ = knn_points(x, max(k_list), compute_dtype=compute_dtype)
     all_labels, all_nc, all_scores = [], [], []
     for ki, k in enumerate(k_list):
-        idx, _ = knn_points(x, k, compute_dtype=compute_dtype)
-        graph = snn_graph(idx)
+        graph = snn_graph(idx_max[:, :k])
         keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
 
         def one_res(kk, res):

@@ -40,6 +40,33 @@ def test_knn_matches_bruteforce_numpy(rng):
         np.testing.assert_allclose(np.sort(dist[i]), np.sort(np.sqrt(d2[i][list(expected)])), rtol=1e-4)
 
 
+def test_knn_prefix_nesting_exact():
+    """cluster_grid computes kNN once at max(k) and prefix-slices for the
+    smaller ks — that is only sound if top-k lists are bit-identical prefixes
+    (deterministic top_k with ties to the lower index; degenerate-n padding
+    repeats the same last true column). Lock the property, including the
+    blockwise path and the n-1 < k padding case."""
+    r = np.random.default_rng(8)
+    x = r.normal(size=(300, 6)).astype(np.float32)
+    idx20 = np.asarray(knn_points(x, 20)[0])
+    for k in (5, 10, 15):
+        np.testing.assert_array_equal(
+            idx20[:, :k], np.asarray(knn_points(x, k)[0])
+        )
+    # blockwise path (n > 2*block)
+    xb = r.normal(size=(130, 3)).astype(np.float32)
+    big = np.asarray(knn_points(xb, 12, block=32)[0])
+    np.testing.assert_array_equal(
+        big[:, :7], np.asarray(knn_points(xb, 7, block=32)[0])
+    )
+    # degenerate padding: n-1 < k for both calls
+    xt = r.normal(size=(6, 2)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(knn_points(xt, 10)[0])[:, :8],
+        np.asarray(knn_points(xt, 8)[0]),
+    )
+
+
 def test_knn_from_distance_matrix(rng):
     d = rng.uniform(size=(20, 20)).astype(np.float32)
     d = (d + d.T) / 2

@@ -11,7 +11,12 @@ tunnel (sitecustomize registers the axon platform at interpreter start).
 
 Usage:
     python tools/tpu_watch.py [--outdir docs/tpu_evidence_raw] \
-        [--budget-secs 28800] [--poll-secs 240]
+        [--budget-secs 28800] [--poll-secs 240] \
+        [--cooldown-secs 60] [--done <step-name> ...]
+
+The watcher pauses --cooldown-secs between worker sessions (a fresh jax
+process launched right after one exits has been observed to hang on
+backend init) and skips any step named via --done (already banked).
 
 Writes <outdir>/status.json after every state change.
 """
@@ -72,11 +77,21 @@ def main() -> int:
     ap.add_argument("--outdir", default=os.path.join(REPO, "docs", "tpu_evidence_raw"))
     ap.add_argument("--budget-secs", type=int, default=8 * 3600)
     ap.add_argument("--poll-secs", type=int, default=240)
+    ap.add_argument("--cooldown-secs", type=int, default=60,
+                    help="pause between worker sessions: launching a fresh "
+                         "jax process right after one exits has been observed "
+                         "to hang on backend init (r5, t+03:48)")
+    ap.add_argument("--done", action="append", default=[],
+                    help="step name already banked this round; skip it")
     args = ap.parse_args()
+    known = {s[0] for s in STEPS}
+    unknown = [d for d in args.done if d not in known]
+    if unknown:
+        ap.error(f"--done {unknown}: not in {sorted(known)}")
     os.makedirs(args.outdir, exist_ok=True)
 
     t_start = time.time()
-    done: dict = {}
+    done: dict = {name: "ok" for name in args.done}
     probes = 0
 
     def save_status(state: str) -> None:
@@ -106,6 +121,9 @@ def main() -> int:
             save_status("waiting")
             time.sleep(args.poll_secs)
             continue
+        # the probe was itself a worker session; cool down before the first
+        # real step for the same reason as between steps
+        time.sleep(args.cooldown_secs)
 
         for name, argv, step_timeout in remaining:
             log_path = os.path.join(args.outdir, f"{name}.log")
@@ -129,6 +147,9 @@ def main() -> int:
             print(f"tpu_watch: {name} -> {status} "
                   f"({time.time()-t0:.0f}s)", flush=True)
             save_status("running")
+            # let the tunnel reap the finished worker before the next
+            # session (step OR probe) starts — see --cooldown-secs help
+            time.sleep(args.cooldown_secs)
             if status != "ok":
                 # window may have closed; go back to probing
                 break

@@ -103,7 +103,15 @@ def main() -> int:
                 "steps_done": {k: v for k, v in done.items()},
             }, f, indent=1)
 
-    bench_env = dict(os.environ, BENCH_CELLS="10000", BENCH_BOOTS="24")
+    # PYTHONPATH must include the repo root: the tools/ scripts import the
+    # package, and a script's sys.path[0] is tools/, not the cwd (this
+    # silently 404'd every step of the first healthy window of r5)
+    bench_env = dict(
+        os.environ, BENCH_CELLS="10000", BENCH_BOOTS="24",
+        PYTHONPATH=os.pathsep.join(
+            [REPO] + [p for p in [os.environ.get("PYTHONPATH")] if p]
+        ),
+    )
 
     while time.time() - t_start < args.budget_secs:
         remaining = [s for s in STEPS if done.get(s[0]) != "ok"]

@@ -27,6 +27,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from consensusclustr_tpu.utils.backend import default_backend as _default_backend
+
 # Which implementation the last coclustering_distance call used:
 # "pallas" | "einsum". Read by bench.py to report the measured path.
 LAST_PATH: str = "einsum"
@@ -37,7 +39,7 @@ def _pallas_wanted(use_pallas: Optional[bool], max_clusters: int) -> bool:
     config flag beats the backend default — the env var must win even over an
     explicit use_pallas=True so a broken kernel can be disabled fleet-wide
     without touching configs. The kernel needs int8-compact labels."""
-    if max_clusters > 127 or jax.default_backend() != "tpu":
+    if max_clusters > 127 or _default_backend() != "tpu":
         return False
     if os.environ.get("CCTPU_NO_PALLAS"):
         return False
@@ -71,7 +73,7 @@ def coclustering_distance(
         )
 
         try:
-            out = pallas_coclustering_distance(labels)
+            out = pallas_coclustering_distance(labels, n_classes=max_clusters)
             LAST_PATH = "pallas"
             return out
         except Exception as e:  # Mosaic compile or OOM: degrade, don't die

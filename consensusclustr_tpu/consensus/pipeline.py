@@ -48,6 +48,7 @@ from consensusclustr_tpu.consensus.merge import (
     merge_small_clusters,
     merge_unstable_clusters,
 )
+from consensusclustr_tpu.utils.backend import default_backend as _default_backend
 from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
 
@@ -127,7 +128,7 @@ def _auto_boot_chunk(
     kc = min(_auto_kc(m), m)
     coarse_bytes = n_res * kc * kc * 4.0 * 6.0
     per_boot = knn_bytes + coarse_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
-    backend = jax.default_backend()
+    backend = _default_backend()
     on_cpu = backend == "cpu"
     budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9 if on_cpu else 6e9))
     # TPU cap: XLA compile time grows superlinearly with the vmapped boot

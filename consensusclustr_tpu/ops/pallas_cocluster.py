@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the co-clustering (consensus Jaccard) distance.
+"""Pallas TPU kernels for the co-clustering (consensus Jaccard) distance.
 
 The bandwidth-lean variant of consensus/cocluster.py — the reference's inline
 Armadillo kernel + parDist/OpenMP pass (reference R/consensusClust.R:411-421):
@@ -6,21 +6,36 @@ Armadillo kernel + parDist/OpenMP pass (reference R/consensusClust.R:411-421):
     dist(i, j) = 1 - #(L_i == L_j, both sampled) / #(both sampled)
 
 The XLA einsum path one-hot encodes labels to ride the MXU, which round-trips
-a [chunk, n, max_clusters] bf16 tensor through HBM per scan step. This kernel
-instead tiles the n x n output over an (i, j, boot-block) grid and streams the
-raw int8 label matrix: each program step holds two [BOOT_BLOCK, TILE] label
-tiles in VMEM (~128 KB each at BOOT_BLOCK=512, TILE=256) and accumulates
-agreement/valid counts in int32 VMEM scratch with VPU compares. The boot axis
-is the innermost grid dimension, so arbitrarily large B (granular mode:
+a [chunk, n, max_clusters] bf16 tensor through HBM per scan step. Both
+kernels here instead tile the n x n output over an (i, j, boot-block) grid
+and stream the raw int8 label matrix: each program step holds two
+[BOOT_BLOCK, TILE] label tiles in VMEM (~128 KB each at BOOT_BLOCK=512,
+TILE=256) and accumulates agreement/valid counts in VMEM scratch. The boot
+axis is the innermost grid dimension, so arbitrarily large B (granular mode:
 nboots x |k| x |res|) streams through fixed VMEM instead of residing whole —
-no one-hot ever exists, and each output tile is written exactly once, fused
-with the final 1 - agree/union division.
+no one-hot ever touches HBM, and each output tile is written exactly once,
+fused with the final 1 - agree/union division.
 
-Mosaic constraint honored here: minor-dim insertion (`x[:, :, None]`) is only
-supported for 32-bit types, so labels are widened to int32 *before* any
-broadcast reshape and all mask algebra is int32 arithmetic — no i1/i8 vector
-ever gets a new minor dimension (this exact pattern failed to compile in
-round 2: `tpu.reshape vector<8x256xi1> -> vector<8x256x1xi1>`).
+Two variants (CCTPU_PALLAS_VARIANT=mxu|vpu, default mxu):
+
+* ``mxu`` — builds the boot-chunk one-hot [CHUNK * n_classes, TILE] in bf16
+  *inside VMEM* and turns both counts into MXU matmuls with f32 accumulation
+  (integer-exact: every product is 0/1 and counts stay < 2^24, so parity
+  with the einsum oracle is still bit-exact). This is the einsum path's
+  math with its HBM round-trip amputated.
+* ``vpu`` — the round-2-era compare-and-sum body: int32 mask algebra over
+  [CHUNK, TILE, TILE] broadcasts on the VPU. First hardware measurement
+  (docs/tpu_evidence_raw/pallas_parity.log, TPU v5e) put it ~50x off VPU
+  peak and losing to einsum on tall few-boot shapes — kept as the
+  known-compiles fallback and for A/B timing on chip.
+
+Mosaic constraints honored here: minor-dim insertion (`x[:, :, None]`) is
+only supported for 32-bit types, so labels are widened to int32 *before* any
+broadcast reshape, and no i1/i8 vector ever gets a new minor dimension (this
+exact pattern failed to compile in round 2: `tpu.reshape vector<8x256xi1>`).
+The mxu one-hot reshape [C, NCLS, T] -> [C * NCLS, T] collapses major dims
+only (minor dim untouched) on bf16, with NCLS padded to a multiple of 32 so
+the collapse stays sublane-aligned.
 
 Numerical contract matches coclustering_distance exactly: never-co-sampled
 pairs get distance 1, diagonal forced to 0.
@@ -29,6 +44,7 @@ pairs get distance 1, diagonal forced to 0.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,14 +53,78 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE = 256          # output tile edge; multiple of the (32, 128) int8 tile
 BOOT_BLOCK = 512    # boots streamed per grid step (int8 tile: 128 KB in VMEM)
-BOOT_CHUNK = 8      # boots per VPU accumulation step inside a block
+BOOT_CHUNK = 8      # boots per accumulation step inside a block
 
 
-def _cocluster_kernel(li_ref, lj_ref, out_ref, agree_ref, union_ref):
+def _kernel_mxu(li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes):
     """li_ref/lj_ref: [boot_block, TILE] int8 label tiles (one boot block);
-    out_ref: [TILE, TILE] f32; agree/union: int32 VMEM scratch accumulators
+    out_ref: [TILE, TILE] f32; agree/union: f32 VMEM scratch accumulators
     that persist across the boot grid dimension (innermost, so the (i, j)
-    output block is fixed while boot blocks stream)."""
+    output block is fixed while boot blocks stream).
+
+    agree[x, y] = sum_{b, c} 1[li[b, x] == c] * 1[lj[b, y] == c] is a single
+    [TILE, K] x [K, TILE] contraction per boot chunk with K = CHUNK * NCLS;
+    union[x, y] = sum_b 1[li[b, x] >= 0] * 1[lj[b, y] >= 0] a second one with
+    K = CHUNK. Masked entries (-1) one-hot to the zero vector, so no
+    validity multiply is needed on the agree side.
+    """
+    boot_block = li_ref.shape[0]
+    nb = pl.num_programs(2)
+    b = pl.program_id(2)
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(b == 0)
+    def _init():
+        agree_ref[:] = jnp.zeros((TILE, TILE), jnp.float32)
+        union_ref[:] = jnp.zeros((TILE, TILE), jnp.float32)
+
+    one = jnp.bfloat16(1.0)
+    zero = jnp.bfloat16(0.0)
+    contract0 = (((0,), (0,)), ((), ()))  # sum over rows of both operands
+
+    def body(c, carry):
+        agree, union = carry
+        li = li_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :].astype(jnp.int32)
+        lj = lj_ref[pl.ds(c * BOOT_CHUNK, BOOT_CHUNK), :].astype(jnp.int32)
+        cls = jax.lax.broadcasted_iota(
+            jnp.int32, (BOOT_CHUNK, n_classes, TILE), 1
+        )
+        # [C, NCLS, T] bf16 one-hot, built and consumed entirely in VMEM
+        ai = jnp.where(li[:, None, :] == cls, one, zero)
+        aj = jnp.where(lj[:, None, :] == cls, one, zero)
+        ai = ai.reshape(BOOT_CHUNK * n_classes, TILE)
+        aj = aj.reshape(BOOT_CHUNK * n_classes, TILE)
+        agree = agree + jax.lax.dot_general(
+            ai, aj, contract0, preferred_element_type=jnp.float32
+        )
+        vi = jnp.where(li >= 0, one, zero)                    # [C, T] bf16
+        vj = jnp.where(lj >= 0, one, zero)
+        union = union + jax.lax.dot_general(
+            vi, vj, contract0, preferred_element_type=jnp.float32
+        )
+        return agree, union
+
+    acc = (agree_ref[:], union_ref[:])
+    agree, union = jax.lax.fori_loop(0, boot_block // BOOT_CHUNK, body, acc)
+    agree_ref[:] = agree
+    union_ref[:] = union
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        # agree/union hold exact integers in f32; the division below sees
+        # the same operand values as the vpu variant's int->f32 casts, so
+        # the result is bit-identical across variants and vs the oracle.
+        jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
+        dist = 1.0 - jac
+        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+        on_diag = (i == j) & (rows == cols)
+        out_ref[:] = jnp.where(on_diag, 0.0, dist)
+
+
+def _kernel_vpu(li_ref, lj_ref, out_ref, agree_ref, union_ref):
+    """Compare-and-sum body (int32 VPU algebra, int32 scratch). See module
+    docstring; kept verbatim from the first hardware-proven build."""
     boot_block = li_ref.shape[0]
     # grid queries hoisted out of the pl.when closures: program_id inside a
     # when-body fails to lower in interpret mode (cond-wrapped primitive)
@@ -91,18 +171,12 @@ def _cocluster_kernel(li_ref, lj_ref, out_ref, agree_ref, union_ref):
         out_ref[:] = jnp.where(on_diag, 0.0, dist)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def pallas_coclustering_distance(
-    labels: jax.Array, interpret: bool = False
+@functools.partial(
+    jax.jit, static_argnames=("n_classes", "variant", "interpret")
+)
+def _pallas_cocluster(
+    labels: jax.Array, n_classes: int, variant: str, interpret: bool
 ) -> jax.Array:
-    """labels: [B, n] integer assignments, -1 = unsampled. Returns [n, n]
-    float32 co-clustering distance (diagonal 0, never-co-sampled pairs 1).
-
-    Cluster ids must fit int8 (the engine's compact labels are bounded by
-    max_clusters <= 127; -1 is the mask). Pads B to BOOT_BLOCK and n to TILE
-    with -1, which contribute nothing to either count.
-    """
-    labels = jnp.asarray(labels)
     b, n = labels.shape
     # block the boot axis to BOOT_CHUNK granularity, capped at BOOT_BLOCK —
     # small B (robust mode: nboots ~ 100) pads to the next chunk, not to 512
@@ -112,11 +186,20 @@ def pallas_coclustering_distance(
     lab8 = jnp.full((b_pad, n_pad), -1, jnp.int8)
     lab8 = jax.lax.dynamic_update_slice(lab8, labels.astype(jnp.int8), (0, 0))
 
+    if variant == "mxu":
+        kernel = functools.partial(_kernel_mxu, n_classes=n_classes)
+        scratch_dtype = jnp.float32
+        flops = 2 * b_pad * (n_classes + 1) * n_pad * n_pad
+    else:
+        kernel = _kernel_vpu
+        scratch_dtype = jnp.int32
+        flops = 2 * b_pad * n_pad * n_pad
+
     # boot axis innermost: the (i, j) output block stays fixed in VMEM while
     # boot blocks stream past the scratch accumulators.
     grid = (n_pad // TILE, n_pad // TILE, b_pad // boot_block)
     out = pl.pallas_call(
-        _cocluster_kernel,
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -131,14 +214,42 @@ def pallas_coclustering_distance(
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((TILE, TILE), jnp.int32),
-            pltpu.VMEM((TILE, TILE), jnp.int32),
+            pltpu.VMEM((TILE, TILE), scratch_dtype),
+            pltpu.VMEM((TILE, TILE), scratch_dtype),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=2 * b_pad * n_pad * n_pad,
+            flops=flops,
             bytes_accessed=2 * b_pad * n_pad * (n_pad // TILE) + 4 * n_pad * n_pad,
             transcendentals=0,
         ),
         interpret=interpret,
     )(lab8, lab8)
     return out[:n, :n]
+
+
+def pallas_coclustering_distance(
+    labels: jax.Array,
+    n_classes: int = 128,
+    variant: str | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """labels: [B, n] integer assignments, -1 = unsampled. Returns [n, n]
+    float32 co-clustering distance (diagonal 0, never-co-sampled pairs 1).
+
+    Cluster ids must fit int8 (the engine's compact labels are bounded by
+    max_clusters <= 127; -1 is the mask); ``n_classes`` is an upper bound on
+    label values (callers pass ClusterConfig-derived max_clusters — same
+    contract as the einsum oracle's arange(max_clusters)). Pads B to the
+    boot block and n to TILE with -1, which contribute nothing to either
+    count. ``variant`` defaults to $CCTPU_PALLAS_VARIANT or "mxu"; resolved
+    here, outside jit, so the env knob is honored per call.
+    """
+    if variant is None:
+        variant = os.environ.get("CCTPU_PALLAS_VARIANT", "mxu")
+    if variant not in ("mxu", "vpu"):
+        raise ValueError(f"unknown pallas variant {variant!r}")
+    # NCLS: cover labels 0..n_classes-1, sublane-aligned (multiple of 32),
+    # int8 bound 128. Padding classes one-hot to zero columns — harmless.
+    ncls = min(128, max(32, -(-int(n_classes) // 32) * 32))
+    labels = jnp.asarray(labels)
+    return _pallas_cocluster(labels, ncls, variant, interpret)

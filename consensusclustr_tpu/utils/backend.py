@@ -1,0 +1,52 @@
+"""Backend identification that cannot hang on a dead accelerator link.
+
+`jax.default_backend()` initializes the default PJRT client, and on this
+sandbox's tunneled TPU the axon plugin's `get_backend` hook dials the
+serving tunnel — a wedged tunnel then blocks *indefinitely*, even when
+`JAX_PLATFORMS=cpu` pins the process to the host platform (observed r5:
+an e2e CPU run sat >25 min inside `enable_persistent_cache`'s backend
+probe with 8 s of CPU time).
+
+When JAX_PLATFORMS names the platform explicitly there is nothing to
+probe: trust the env and never touch the backend registry. Only an
+unpinned process (empty/unset JAX_PLATFORMS, i.e. "autodetect") pays the
+real `jax.default_backend()` call — which is then the correct, intended
+behavior, wedge risk included, because the answer genuinely depends on
+what initializes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def default_backend() -> str:
+    """The default platform name, resolved from $JAX_PLATFORMS when pinned.
+
+    The axon plugin serves TPU devices (jax.default_backend() reports
+    "tpu" under it), so "axon" maps to "tpu" here.
+
+    When the env pins plain "cpu", also re-pin jax's *config*: the axon
+    sitecustomize sets jax_platforms="axon,cpu" at interpreter start,
+    overriding the env, so without this the process's first device op
+    still dials the accelerator plugin (tests/conftest.py applies the
+    same correction for the pytest process).
+    """
+    env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if "," in env:
+        # a list ("tpu,cpu") is a fallback preference, not a pin — which
+        # entry actually initialized is only knowable from the real probe
+        import jax
+
+        return jax.default_backend()
+    if env == "cpu":
+        import jax
+
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    if env:
+        return "tpu" if env == "axon" else env
+    import jax
+
+    return jax.default_backend()

@@ -15,6 +15,8 @@ import os
 
 import jax
 
+from consensusclustr_tpu.utils.backend import default_backend
+
 _done = False
 
 
@@ -27,7 +29,7 @@ def enable_persistent_cache() -> None:
     # SAME process's host, plus "machine features mismatch ... SIGILL"
     # warnings from the AOT loader). CPU compiles are cheap anyway — the
     # cache only pays for itself on accelerators, so enable it only there.
-    if jax.default_backend() == "cpu":
+    if default_backend() == "cpu":
         _done = True
         return
     cache_dir = os.environ.get(

@@ -18,25 +18,54 @@ def _oracle(labels, max_clusters=64):
     )
 
 
+@pytest.mark.parametrize("variant", ["mxu", "vpu"])
 @pytest.mark.parametrize("b,n", [(5, 40), (8, 256), (13, 300)])
-def test_pallas_cocluster_matches_einsum(b, n):
+def test_pallas_cocluster_matches_einsum(b, n, variant):
     r = np.random.default_rng(b * 1000 + n)
     labels = r.integers(-1, 6, size=(b, n)).astype(np.int32)
-    got = np.asarray(pallas_coclustering_distance(jnp.asarray(labels), interpret=True))
+    got = np.asarray(
+        pallas_coclustering_distance(
+            jnp.asarray(labels), 8, variant=variant, interpret=True
+        )
+    )
     np.testing.assert_allclose(got, _oracle(labels, 8), atol=1e-6)
 
 
-def test_pallas_cocluster_never_cosampled():
+@pytest.mark.parametrize("variant", ["mxu", "vpu"])
+def test_pallas_cocluster_never_cosampled(variant):
     # cells 0 and 1 are never sampled in the same boot -> distance 1
     labels = np.asarray([[0, -1, 0], [-1, 1, 1]], np.int32)
-    got = np.asarray(pallas_coclustering_distance(jnp.asarray(labels), interpret=True))
+    got = np.asarray(
+        pallas_coclustering_distance(
+            jnp.asarray(labels), 4, variant=variant, interpret=True
+        )
+    )
     assert got[0, 1] == pytest.approx(1.0)
     np.testing.assert_allclose(got, _oracle(labels, 4), atol=1e-6)
 
 
-def test_pallas_cocluster_all_masked_column():
+@pytest.mark.parametrize("variant", ["mxu", "vpu"])
+def test_pallas_cocluster_all_masked_column(variant):
     labels = np.full((4, 10), -1, np.int32)
     labels[:, :5] = 2
-    got = np.asarray(pallas_coclustering_distance(jnp.asarray(labels), interpret=True))
+    got = np.asarray(
+        pallas_coclustering_distance(
+            jnp.asarray(labels), 4, variant=variant, interpret=True
+        )
+    )
     np.testing.assert_allclose(got, _oracle(labels, 4), atol=1e-6)
     assert np.all(np.diag(got) == 0.0)
+
+
+def test_pallas_cocluster_labels_at_class_bound():
+    # labels at n_classes - 1 with an un-aligned n_classes request: the
+    # sublane-padded NCLS must still count class 126 correctly
+    r = np.random.default_rng(7)
+    labels = r.integers(-1, 127, size=(6, 64)).astype(np.int32)
+    for variant in ("mxu", "vpu"):
+        got = np.asarray(
+            pallas_coclustering_distance(
+                jnp.asarray(labels), 127, variant=variant, interpret=True
+            )
+        )
+        np.testing.assert_allclose(got, _oracle(labels, 127), atol=1e-6)

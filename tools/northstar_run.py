@@ -37,7 +37,11 @@ def main() -> int:
     n_genes = int(os.environ.get("NS_GENES", 2000))
     ckpt = os.environ.get("NS_CKPT", os.path.abspath("northstar_ckpt"))
     mode = os.environ.get("NS_MODE", "robust")
-    backend = jax.default_backend()
+    # env-first: a JAX_PLATFORMS=cpu run must not dial a wedged tunnel
+    # (and must re-pin jax's config past the sitecustomize override)
+    from consensusclustr_tpu.utils.backend import default_backend
+
+    backend = default_backend()
     print(f"backend={backend} n={n} boots={nboots} res={n_res} ckpt={ckpt}",
           flush=True)
 

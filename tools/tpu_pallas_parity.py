@@ -52,38 +52,48 @@ def main() -> int:
     }
     out: dict = {}
     ok = True
+    # mxu first (the current default), vpu second (the r5 A/B baseline,
+    # hardware-proven 2026-07-31) — each timed cold+warm vs the einsum
+    # oracle so every healthy window banks a before/after pair on chip.
+    variants = ("mxu", "vpu")
     for name, (b, n, c) in shapes.items():
         lab = rng.integers(-1, c, size=(b, n)).astype(np.int32)
         lab_dev = jnp.asarray(lab)
+        rec: dict = {}
 
         t0 = time.time()
-        d_pallas = pallas_coclustering_distance(lab_dev)
-        d_pallas_host = np.asarray(d_pallas)  # host fetch = real sync
-        t_pallas_cold = time.time() - t0
-
+        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, c))
+        rec["einsum_cold_s"] = round(time.time() - t0, 3)
         t0 = time.time()
-        d_pallas_host = np.asarray(pallas_coclustering_distance(lab_dev))
-        t_pallas_warm = time.time() - t0
+        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, c))
+        rec["einsum_warm_s"] = round(time.time() - t0, 3)
 
-        t0 = time.time()
-        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, 64))
-        t_einsum_cold = time.time() - t0
-        t0 = time.time()
-        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, 64))
-        t_einsum_warm = time.time() - t0
+        for variant in variants:
+            t0 = time.time()
+            d_pallas = np.asarray(  # host fetch = real sync
+                pallas_coclustering_distance(lab_dev, c, variant=variant)
+            )
+            rec[f"{variant}_cold_s"] = round(time.time() - t0, 3)
+            t0 = time.time()
+            d_pallas = np.asarray(
+                pallas_coclustering_distance(lab_dev, c, variant=variant)
+            )
+            rec[f"{variant}_warm_s"] = round(time.time() - t0, 3)
+            diff = float(np.max(np.abs(d_pallas - d_oracle)))
+            rec[f"{variant}_max_abs_diff"] = diff
+            ok = ok and diff < 1e-5
 
-        diff = float(np.max(np.abs(d_pallas_host - d_oracle)))
-        out[name] = {
-            "max_abs_diff": diff,
-            "pallas_cold_s": round(t_pallas_cold, 3),
-            "pallas_warm_s": round(t_pallas_warm, 3),
-            "einsum_cold_s": round(t_einsum_cold, 3),
-            "einsum_warm_s": round(t_einsum_warm, 3),
-        }
-        ok = ok and diff < 1e-5
-        print(f"{name}: max_diff={diff:.2e} pallas {t_pallas_warm*1e3:.1f} ms "
-              f"(cold {t_pallas_cold:.1f} s) einsum {t_einsum_warm*1e3:.1f} ms "
-              f"(cold {t_einsum_cold:.1f} s)", flush=True)
+        out[name] = rec
+        print(
+            f"{name}: "
+            + " ".join(
+                f"{v}: diff={rec[f'{v}_max_abs_diff']:.2e} "
+                f"{rec[f'{v}_warm_s']*1e3:.1f} ms"
+                for v in variants
+            )
+            + f" einsum {rec['einsum_warm_s']*1e3:.1f} ms",
+            flush=True,
+        )
 
     print(json.dumps(
         {"pallas_hardware_parity": out, "backend": backend, "ok": ok}

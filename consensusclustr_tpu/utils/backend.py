@@ -7,12 +7,16 @@ serving tunnel — a wedged tunnel then blocks *indefinitely*, even when
 an e2e CPU run sat >25 min inside `enable_persistent_cache`'s backend
 probe with 8 s of CPU time).
 
-When JAX_PLATFORMS names the platform explicitly there is nothing to
-probe: trust the env and never touch the backend registry. Only an
-unpinned process (empty/unset JAX_PLATFORMS, i.e. "autodetect") pays the
-real `jax.default_backend()` call — which is then the correct, intended
-behavior, wedge risk included, because the answer genuinely depends on
-what initializes.
+When `JAX_PLATFORMS=cpu` pins the process, there is nothing to probe:
+trust the env, re-pin jax's config, and never touch the backend
+registry. Otherwise a single-platform jax *config* value (the more
+current signal — bench.py's CPU forcing and the test conftest both
+select via config while the launch env still names the accelerator)
+answers without a probe. Only a genuinely ambiguous process (platform
+list like "axon,cpu", or nothing set) pays the real
+`jax.default_backend()` call — which is then the correct, intended
+behavior, wedge risk included, because the answer depends on what
+initializes.
 """
 
 from __future__ import annotations
@@ -33,20 +37,28 @@ def default_backend() -> str:
     same correction for the pytest process).
     """
     env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if "," in env:
-        # a list ("tpu,cpu") is a fallback preference, not a pin — which
-        # entry actually initialized is only knowable from the real probe
-        import jax
-
-        return jax.default_backend()
     if env == "cpu":
         import jax
 
         if jax.config.jax_platforms != "cpu":
             jax.config.update("jax_platforms", "cpu")
         return "cpu"
-    if env:
-        return "tpu" if env == "axon" else env
+    # For anything but an env cpu-pin, the live config is the more current
+    # signal: bench.py's CCTPU_FORCE_CPU and tests/conftest.py both select
+    # cpu via the config while the launch env still names the accelerator —
+    # reporting "tpu" there would e.g. enable the persistent compile cache
+    # on an XLA:CPU process (a known SIGSEGV source, see compile_cache.py).
     import jax
 
+    cfg = (jax.config.jax_platforms or "").strip().lower()
+    if cfg and "," not in cfg:
+        return "tpu" if cfg == "axon" else cfg
+    if env and "," not in env:
+        # config is unset or an ambiguous fallback list ("axon,cpu" — the
+        # sitecustomize default), but the launch env names one platform:
+        # trust it rather than pay the wedge-prone probe (JAX_PLATFORMS=axon
+        # is the driver's normal accelerator pin)
+        return "tpu" if env == "axon" else env
+    # nothing pinned anywhere: which platform initializes is only knowable
+    # from the real probe
     return jax.default_backend()

@@ -171,6 +171,7 @@ def _run() -> dict:
     from consensusclustr_tpu import consensus as _  # noqa: F401  (import check)
     from consensusclustr_tpu.config import ClusterConfig
     from consensusclustr_tpu.consensus import cocluster as cocluster_mod
+    from consensusclustr_tpu.ops import pallas_cocluster as _pallas_mod
     from consensusclustr_tpu.consensus.cocluster import coclustering_distance
     from consensusclustr_tpu.consensus.pipeline import run_bootstraps
     from consensusclustr_tpu.utils.rng import root_key
@@ -209,6 +210,11 @@ def _run() -> dict:
     run()
     dt = time.perf_counter() - t0
     boots_per_sec = nboots / dt
+    # snapshot BEFORE the parity block below: its small dispatch also sets
+    # LAST_PATH/LAST_VARIANT and could misattribute the timed number (e.g.
+    # timed run fell back to einsum, tiny parity shape compiled on Pallas)
+    timed_path = cocluster_mod.LAST_PATH
+    timed_variant = _pallas_mod.LAST_VARIANT if timed_path == "pallas" else None
 
     # On-accelerator parity artifact: the dispatched kernel (Pallas on TPU)
     # against the einsum oracle on a small labels sample. Honesty contract
@@ -236,7 +242,8 @@ def _run() -> dict:
         "unit": "boots/s",
         "vs_baseline": round(boots_per_sec / NORTH_STAR_BOOTS_PER_SEC, 3),
         "backend": backend,
-        "path": cocluster_mod.LAST_PATH,
+        "path": timed_path,
+        "pallas_variant": timed_variant,
         "pallas_parity_max_diff": parity,
         "cells": n,
         "boots": nboots,

@@ -25,6 +25,22 @@ Public API mirrors the reference's four exports
 
 from consensusclustr_tpu.config import ClusterConfig, DEFAULT_RES_RANGE
 
+# A JAX_PLATFORMS=cpu process must never dial the accelerator plugin, but
+# the plugin's sitecustomize re-pins jax's config at interpreter start —
+# honor the env pin the moment the package is imported. Inlined (os-only,
+# jax only under the cpu pin) rather than importing utils.backend, whose
+# package __init__ would pull jax and defeat the lazy-import design below;
+# utils/backend.py::repin_cpu_from_env is the documented form of this check.
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax as _jax
+
+    if _jax.config.jax_platforms != "cpu":
+        _jax.config.update("jax_platforms", "cpu")
+    del _jax
+del _os
+
 __version__ = "0.1.0"
 
 # Lazy top-level exports (PEP 562): keeps `import consensusclustr_tpu.prep`

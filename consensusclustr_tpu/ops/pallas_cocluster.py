@@ -55,6 +55,11 @@ TILE = 256          # output tile edge; multiple of the (32, 128) int8 tile
 BOOT_BLOCK = 512    # boots streamed per grid step (int8 tile: 128 KB in VMEM)
 BOOT_CHUNK = 8      # boots per accumulation step inside a block
 
+# Which variant the last pallas_coclustering_distance call resolved to
+# ("mxu" | "vpu") — the reporting source of truth for bench.py, set where
+# the resolution happens so env/default changes can't desynchronize it.
+LAST_VARIANT: str = "mxu"
+
 
 def _kernel_mxu(li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes):
     """li_ref/lj_ref: [boot_block, TILE] int8 label tiles (one boot block);
@@ -244,10 +249,12 @@ def pallas_coclustering_distance(
     count. ``variant`` defaults to $CCTPU_PALLAS_VARIANT or "mxu"; resolved
     here, outside jit, so the env knob is honored per call.
     """
+    global LAST_VARIANT
     if variant is None:
         variant = os.environ.get("CCTPU_PALLAS_VARIANT", "mxu")
     if variant not in ("mxu", "vpu"):
         raise ValueError(f"unknown pallas variant {variant!r}")
+    LAST_VARIANT = variant
     # NCLS: cover labels 0..n_classes-1, sublane-aligned (multiple of 32),
     # int8 bound 128. Padding classes one-hot to zero columns — harmless.
     ncls = min(128, max(32, -(-int(n_classes) // 32) * 32))

@@ -24,6 +24,22 @@ from __future__ import annotations
 import os
 
 
+def repin_cpu_from_env() -> None:
+    """If $JAX_PLATFORMS pins plain "cpu", force jax's config to match.
+
+    The platform plugin's sitecustomize sets jax_platforms="axon,cpu" at
+    interpreter start, overriding the env — so without this, a cpu-pinned
+    process's first device op still dials the accelerator plugin (which
+    blocks forever on a wedged link). Called at package import: the cpu
+    branch can never probe anything, so it is hang-free by construction.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+
+
 def default_backend() -> str:
     """The default platform name, resolved from $JAX_PLATFORMS when pinned.
 
@@ -38,10 +54,7 @@ def default_backend() -> str:
     """
     env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
     if env == "cpu":
-        import jax
-
-        if jax.config.jax_platforms != "cpu":
-            jax.config.update("jax_platforms", "cpu")
+        repin_cpu_from_env()
         return "cpu"
     # For anything but an env cpu-pin, the live config is the more current
     # signal: bench.py's CCTPU_FORCE_CPU and tests/conftest.py both select

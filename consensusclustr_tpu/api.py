@@ -771,7 +771,8 @@ def consensus_clust(
             code_of = {u: i for i, u in enumerate(uniq)}
             codes = np.asarray([code_of[l] for l in labels], np.int32)
             cmat = cocluster_cluster_distance(
-                cons.boot_labels, codes, cfg.max_clusters
+                cons.boot_labels, codes, cfg.max_clusters,
+                use_pallas=cfg.use_pallas,
             )
             dend = dendrogram_from_cluster_distance(cmat, uniq)
         else:

@@ -21,14 +21,82 @@ Downstream consumers and their replacements:
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+import os
+import warnings
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 # Row-tile edge. [block, n] f32 at n=200k is 800 MB — the peak transient.
+# Multiple of the Pallas kernel's TILE (ops/pallas_cocluster.py).
 BW_BLOCK = 1024
+
+
+def _pallas_tile_opts(use_pallas: Optional[bool], max_clusters: int):
+    """Resolve the per-tile kernel choice for the streaming loops.
+
+    Returns (use_pallas, variant, interpret). On TPU the Pallas rows kernel
+    replaces the [chunk, n, C] HBM one-hot of the einsum tile — at north-star
+    scale (50k cells x 1000 boots) that one-hot alone is ~300 GB of HBM
+    traffic re-materialised per row block. CCTPU_PALLAS_INTERPRET=1 runs the
+    same composition in interpret mode (CPU parity tests); it bypasses ONLY
+    the backend gate — the CCTPU_NO_PALLAS kill-switch and the int8
+    compactness bound (max_clusters <= 127) always win, same contract as
+    cocluster._pallas_wanted.
+    """
+    from consensusclustr_tpu.consensus.cocluster import _pallas_wanted
+
+    variant = os.environ.get("CCTPU_PALLAS_VARIANT", "mxu")
+    if variant not in ("mxu", "vpu"):  # same loud contract as the square path
+        raise ValueError(f"unknown pallas variant {variant!r}")
+    interpret = bool(os.environ.get("CCTPU_PALLAS_INTERPRET"))
+    if max_clusters > 127 or os.environ.get("CCTPU_NO_PALLAS"):
+        wanted = False
+    elif interpret:
+        wanted = bool(use_pallas)  # explicit opt-in only, any backend
+    else:
+        wanted = _pallas_wanted(use_pallas, max_clusters)
+    return bool(wanted), variant, interpret
+
+
+def _run_with_tile_fallback(jit_fn, arrays, static_tail, use_pallas, max_clusters):
+    """Shared dispatch: try the Pallas tile, degrade to einsum on failure —
+    the same contract as coclustering_distance (never die on a kernel
+    regression)."""
+    pallas, variant, interpret = _pallas_tile_opts(use_pallas, max_clusters)
+    if pallas:
+        try:
+            out = jit_fn(*arrays, *static_tail, "pallas", variant, interpret)
+            from consensusclustr_tpu.ops import pallas_cocluster as _pc
+
+            _pc.LAST_VARIANT = variant
+            return out
+        except Exception as e:  # Mosaic compile or OOM: degrade, don't die
+            warnings.warn(
+                f"Pallas blockwise tile failed ({type(e).__name__}: {e}); "
+                "falling back to the einsum tile",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+    return jit_fn(*arrays, *static_tail, "einsum", "mxu", False)
+
+
+def _make_tile(labels, n_pad, max_clusters, block, chunk, tile_impl, variant,
+               interpret):
+    """The [block, n_pad] distance-tile closure for the streaming loops."""
+    if tile_impl == "pallas":
+        from consensusclustr_tpu.ops.pallas_cocluster import (
+            pad_labels_int8, pallas_cocluster_rows,
+        )
+
+        lab8 = pad_labels_int8(labels, n_pad)
+        return lambda i: pallas_cocluster_rows(
+            lab8, i * block, block, max_clusters, variant, interpret
+        )
+    labels_s = _onehot_chunks(labels, chunk, max_clusters)
+    return lambda i: _dist_tile(labels_s, i * block, block, max_clusters)
 
 
 def _onehot_chunks(labels: jax.Array, chunk: int, max_clusters: int):
@@ -73,15 +141,13 @@ def _dist_tile(
     return 1.0 - jac
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "max_clusters", "block", "chunk")
-)
 def blockwise_consensus_knn(
     labels: jax.Array,
     k: int,
     max_clusters: int = 64,
     block: int = BW_BLOCK,
     chunk: int = 8,
+    use_pallas: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact co-clustering kNN graph without materialising the distance matrix.
 
@@ -89,8 +155,33 @@ def blockwise_consensus_knn(
     [n, k] f32) sorted by increasing distance, self excluded. Matches
     knn_from_distance(coclustering_distance(labels), k) exactly (same top_k
     tie-breaking), so smaller-k graphs are prefixes of larger-k ones.
+
+    On TPU the [block, n] tile comes from the Pallas rows kernel
+    (ops/pallas_cocluster.py::pallas_cocluster_rows) instead of the einsum
+    tile; a kernel failure degrades to the einsum path with a warning, same
+    contract as coclustering_distance.
     """
-    labels = jnp.asarray(labels, jnp.int32)
+    return _run_with_tile_fallback(
+        _blockwise_knn_jit, (jnp.asarray(labels, jnp.int32),),
+        (k, max_clusters, block, chunk), use_pallas, max_clusters,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "max_clusters", "block", "chunk", "tile_impl",
+                     "variant", "interpret"),
+)
+def _blockwise_knn_jit(
+    labels: jax.Array,
+    k: int,
+    max_clusters: int,
+    block: int,
+    chunk: int,
+    tile_impl: str,
+    variant: str,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
     b, n = labels.shape
     k_eff = min(k, n - 1)
     n_blocks = -(-n // block)
@@ -99,12 +190,13 @@ def blockwise_consensus_knn(
         labels = jnp.concatenate(
             [labels, jnp.full((b, n_pad - n), -1, jnp.int32)], axis=1
         )
-    labels_s = _onehot_chunks(labels, chunk, max_clusters)
+    tile = _make_tile(
+        labels, n_pad, max_clusters, block, chunk, tile_impl, variant, interpret
+    )
     rows_local = jnp.arange(block, dtype=jnp.int32)
 
     def one_block(i):
-        d = _dist_tile(labels_s, i * block, block, max_clusters)      # [block, n_pad]
-        d = d[:, :n]
+        d = tile(i)[:, :n]                                            # [block, n]
         r_global = i * block + rows_local
         self_col = jnp.clip(r_global, 0, n - 1)
         d = d.at[rows_local, self_col].set(jnp.inf)                   # exclude self
@@ -122,9 +214,6 @@ def blockwise_consensus_knn(
     return idx.astype(jnp.int32), -neg
 
 
-@functools.partial(
-    jax.jit, static_argnames=("max_clusters", "n_clusters", "block", "chunk")
-)
 def cocluster_pair_sums(
     labels: jax.Array,        # [B, n] int32 boot assignments
     codes: jax.Array,         # [n] int32 cluster ids in [0, n_clusters)
@@ -132,16 +221,38 @@ def cocluster_pair_sums(
     max_clusters: int = 64,
     block: int = BW_BLOCK,
     chunk: int = 8,
+    use_pallas: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(sums [C, C], counts [C]): summed co-clustering distances between the
     members of each cluster pair, streamed in [block, n] tiles.
 
     sums / outer(counts) is cluster_mean_distance without the dense matrix
     (self-pairs contribute distance 0 on the diagonal, matching the dense
-    path's zeroed diagonal).
+    path's zeroed diagonal). Tile dispatch as in blockwise_consensus_knn.
     """
-    labels = jnp.asarray(labels, jnp.int32)
-    codes = jnp.asarray(codes, jnp.int32)
+    return _run_with_tile_fallback(
+        _pair_sums_jit,
+        (jnp.asarray(labels, jnp.int32), jnp.asarray(codes, jnp.int32)),
+        (n_clusters, max_clusters, block, chunk), use_pallas, max_clusters,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_clusters", "n_clusters", "block", "chunk",
+                     "tile_impl", "variant", "interpret"),
+)
+def _pair_sums_jit(
+    labels: jax.Array,
+    codes: jax.Array,
+    n_clusters: int,
+    max_clusters: int,
+    block: int,
+    chunk: int,
+    tile_impl: str,
+    variant: str,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
     b, n = labels.shape
     n_blocks = -(-n // block)
     n_pad = n_blocks * block
@@ -149,7 +260,9 @@ def cocluster_pair_sums(
         labels = jnp.concatenate(
             [labels, jnp.full((b, n_pad - n), -1, jnp.int32)], axis=1
         )
-    labels_s = _onehot_chunks(labels, chunk, max_clusters)
+    tile = _make_tile(
+        labels, n_pad, max_clusters, block, chunk, tile_impl, variant, interpret
+    )
     oh_all = (codes[:, None] == jnp.arange(n_clusters)[None, :]).astype(jnp.float32)
     codes_pad = jnp.concatenate([codes, jnp.full((n_pad - n,), -1, jnp.int32)])
     oh_pad = (codes_pad[:, None] == jnp.arange(n_clusters)[None, :]).astype(
@@ -158,8 +271,7 @@ def cocluster_pair_sums(
     rows_local = jnp.arange(block, dtype=jnp.int32)
 
     def one_block(acc, i):
-        d = _dist_tile(labels_s, i * block, block, max_clusters)     # [block, n_pad]
-        d = d[:, :n]
+        d = tile(i)[:, :n]                                           # [block, n]
         r_global = i * block + rows_local
         self_col = jnp.clip(r_global, 0, n - 1)
         d = d.at[rows_local, self_col].set(0.0)                      # diag 0
@@ -277,7 +389,10 @@ def euclidean_cluster_distance(
 
 
 def cocluster_cluster_distance(
-    boot_labels: np.ndarray, codes: np.ndarray, max_clusters: int = 64
+    boot_labels: np.ndarray,
+    codes: np.ndarray,
+    max_clusters: int = 64,
+    use_pallas: Optional[bool] = None,
 ) -> np.ndarray:
     """[C, C] mean co-clustering distance between final clusters, streamed —
     the determineHierachy(return="distance") input for the dendrogram when the
@@ -286,7 +401,7 @@ def cocluster_cluster_distance(
     n_clusters = int(codes.max()) + 1
     sums, counts = cocluster_pair_sums(
         jnp.asarray(boot_labels, jnp.int32), jnp.asarray(codes), n_clusters,
-        max_clusters,
+        max_clusters, use_pallas=use_pallas,
     )
     sums = np.asarray(sums, np.float64)
     counts = np.asarray(counts, np.float64)

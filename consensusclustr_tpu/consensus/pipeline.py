@@ -369,7 +369,7 @@ def _finish_consensus(
 
         sums, counts = cocluster_pair_sums(
             jnp.asarray(boot_labels, jnp.int32), jnp.asarray(labels, jnp.int32),
-            cfg.max_clusters, cfg.max_clusters,
+            cfg.max_clusters, cfg.max_clusters, use_pallas=cfg.use_pallas,
         )
         labels = merge_small_clusters_from_sums(
             np.asarray(sums), np.asarray(counts), labels, max(k_list[0], 20)
@@ -490,7 +490,8 @@ def consensus_cluster(
         )
 
         knn_idx, _ = blockwise_consensus_knn(
-            jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters
+            jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters,
+            use_pallas=cfg.use_pallas,
         )
         cons_labels, cons_scores = _consensus_grid_from_knn(
             key, knn_idx, pca, res_list, k_list, cfg.max_clusters,

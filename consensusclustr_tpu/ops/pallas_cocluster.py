@@ -61,7 +61,9 @@ BOOT_CHUNK = 8      # boots per accumulation step inside a block
 LAST_VARIANT: str = "mxu"
 
 
-def _kernel_mxu(li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes):
+def _kernel_mxu(
+    li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes, zero_diag
+):
     """li_ref/lj_ref: [boot_block, TILE] int8 label tiles (one boot block);
     out_ref: [TILE, TILE] f32; agree/union: f32 VMEM scratch accumulators
     that persist across the boot grid dimension (innermost, so the (i, j)
@@ -121,13 +123,15 @@ def _kernel_mxu(li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes):
         # the result is bit-identical across variants and vs the oracle.
         jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
         dist = 1.0 - jac
-        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
-        on_diag = (i == j) & (rows == cols)
-        out_ref[:] = jnp.where(on_diag, 0.0, dist)
+        if zero_diag:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+            on_diag = (i == j) & (rows == cols)
+            dist = jnp.where(on_diag, 0.0, dist)
+        out_ref[:] = dist
 
 
-def _kernel_vpu(li_ref, lj_ref, out_ref, agree_ref, union_ref):
+def _kernel_vpu(li_ref, lj_ref, out_ref, agree_ref, union_ref, *, zero_diag):
     """Compare-and-sum body (int32 VPU algebra, int32 scratch). See module
     docstring; kept verbatim from the first hardware-proven build."""
     boot_block = li_ref.shape[0]
@@ -169,11 +173,76 @@ def _kernel_vpu(li_ref, lj_ref, out_ref, agree_ref, union_ref):
             0.0,
         )
         dist = 1.0 - jac
-        # zero the diagonal of diagonal-grid tiles
-        rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
-        on_diag = (i == j) & (rows == cols)
-        out_ref[:] = jnp.where(on_diag, 0.0, dist)
+        if zero_diag:
+            # zero the diagonal of diagonal-grid tiles
+            rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+            on_diag = (i == j) & (rows == cols)
+            dist = jnp.where(on_diag, 0.0, dist)
+        out_ref[:] = dist
+
+
+def _pad_labels8(labels: jax.Array, b_pad: int, m_pad: int) -> jax.Array:
+    lab8 = jnp.full((b_pad, m_pad), -1, jnp.int8)
+    return jax.lax.dynamic_update_slice(lab8, labels.astype(jnp.int8), (0, 0))
+
+
+def _rect_call(
+    lab_rows8: jax.Array,   # [b_pad, m_pad] int8, -1 padded
+    lab_cols8: jax.Array,   # [b_pad, n_pad] int8, -1 padded
+    n_classes: int,
+    variant: str,
+    interpret: bool,
+    zero_diag: bool,
+) -> jax.Array:
+    """[m_pad, n_pad] distance from padded int8 label tiles (shared core of
+    the square and rectangular entries)."""
+    b_pad, m_pad = lab_rows8.shape
+    _, n_pad = lab_cols8.shape
+    boot_block = min(BOOT_BLOCK, b_pad)
+
+    if variant == "mxu":
+        kernel = functools.partial(
+            _kernel_mxu, n_classes=n_classes, zero_diag=zero_diag
+        )
+        scratch_dtype = jnp.float32
+        flops = 2 * b_pad * (n_classes + 1) * m_pad * n_pad
+    else:
+        kernel = functools.partial(_kernel_vpu, zero_diag=zero_diag)
+        scratch_dtype = jnp.int32
+        flops = 2 * b_pad * m_pad * n_pad
+
+    # boot axis innermost: the (i, j) output block stays fixed in VMEM while
+    # boot blocks stream past the scratch accumulators.
+    grid = (m_pad // TILE, n_pad // TILE, b_pad // boot_block)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (boot_block, TILE), lambda i, j, b: (b, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (boot_block, TILE), lambda i, j, b: (b, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, TILE), lambda i, j, b: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((TILE, TILE), scratch_dtype),
+            pltpu.VMEM((TILE, TILE), scratch_dtype),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=b_pad * (m_pad + n_pad) * max(
+                m_pad // TILE, n_pad // TILE
+            ) + 4 * m_pad * n_pad,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(lab_rows8, lab_cols8)
 
 
 @functools.partial(
@@ -188,48 +257,56 @@ def _pallas_cocluster(
     boot_block = min(BOOT_BLOCK, -(-b // BOOT_CHUNK) * BOOT_CHUNK)
     b_pad = -(-b // boot_block) * boot_block
     n_pad = -(-n // TILE) * TILE
-    lab8 = jnp.full((b_pad, n_pad), -1, jnp.int8)
-    lab8 = jax.lax.dynamic_update_slice(lab8, labels.astype(jnp.int8), (0, 0))
-
-    if variant == "mxu":
-        kernel = functools.partial(_kernel_mxu, n_classes=n_classes)
-        scratch_dtype = jnp.float32
-        flops = 2 * b_pad * (n_classes + 1) * n_pad * n_pad
-    else:
-        kernel = _kernel_vpu
-        scratch_dtype = jnp.int32
-        flops = 2 * b_pad * n_pad * n_pad
-
-    # boot axis innermost: the (i, j) output block stays fixed in VMEM while
-    # boot blocks stream past the scratch accumulators.
-    grid = (n_pad // TILE, n_pad // TILE, b_pad // boot_block)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (boot_block, TILE), lambda i, j, b: (b, i), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (boot_block, TILE), lambda i, j, b: (b, j), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (TILE, TILE), lambda i, j, b: (i, j), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((TILE, TILE), scratch_dtype),
-            pltpu.VMEM((TILE, TILE), scratch_dtype),
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=flops,
-            bytes_accessed=2 * b_pad * n_pad * (n_pad // TILE) + 4 * n_pad * n_pad,
-            transcendentals=0,
-        ),
-        interpret=interpret,
-    )(lab8, lab8)
+    lab8 = _pad_labels8(labels, b_pad, n_pad)
+    out = _rect_call(lab8, lab8, n_classes, variant, interpret, zero_diag=True)
     return out[:n, :n]
+
+
+def pad_labels_int8(labels: jax.Array, n_pad: int) -> jax.Array:
+    """[b_pad, n_pad] int8 labels, -1 padded, ready for the rows kernel.
+
+    Call ONCE outside any tile loop (the conversion is loop-invariant but
+    XLA is not guaranteed to hoist it out of a lax.map body). ``n_pad``
+    must be a multiple of TILE and >= labels.shape[1].
+    """
+    b = labels.shape[0]
+    boot_block = min(BOOT_BLOCK, -(-b // BOOT_CHUNK) * BOOT_CHUNK)
+    b_pad = -(-b // boot_block) * boot_block
+    return _pad_labels8(labels, b_pad, n_pad)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "n_classes", "variant", "interpret")
+)
+def pallas_cocluster_rows(
+    lab8: jax.Array,
+    start: jax.Array,
+    block: int,
+    n_classes: int = 128,
+    variant: str = "mxu",
+    interpret: bool = False,
+) -> jax.Array:
+    """[block, n_pad] co-clustering distance rows ``start .. start+block``
+    against all cells — the blockwise consensus streamer's tile
+    (consensus/blockwise.py) without its [chunk, n, n_classes] HBM one-hot.
+
+    ``lab8`` comes from :func:`pad_labels_int8`. No diagonal zeroing: the
+    caller owns self-pair handling (blockwise sets self-distance to inf for
+    kNN, 0 for pair sums). Rows past the true ``n`` are padding (-1 labels,
+    distance 1) and must be sliced off by the caller. ``block`` and
+    ``start`` must be multiples of TILE.
+    """
+    b_pad, n_pad = lab8.shape
+    if block % TILE:
+        # loud: a non-multiple would floor-divide the grid and leave the
+        # tail rows of the output uninitialized (silent wrong kNN edges)
+        raise ValueError(f"block ({block}) must be a multiple of TILE ({TILE})")
+    # same sublane-aligned class-count normalization as the square entry
+    ncls = min(128, max(32, -(-int(n_classes) // 32) * 32))
+    rows8 = jax.lax.dynamic_slice(
+        lab8, (jnp.int32(0), jnp.asarray(start, jnp.int32)), (b_pad, block)
+    )
+    return _rect_call(rows8, lab8, ncls, variant, interpret, zero_diag=False)
 
 
 def pallas_coclustering_distance(

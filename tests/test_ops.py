@@ -69,3 +69,79 @@ def test_pallas_cocluster_labels_at_class_bound():
             )
         )
         np.testing.assert_allclose(got, _oracle(labels, 127), atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["mxu", "vpu"])
+def test_pallas_rows_tile_matches_dense(variant):
+    """The rectangular rows kernel (blockwise streaming tile) must reproduce
+    the dense oracle's rows exactly — minus the diagonal zeroing it
+    deliberately leaves to the caller."""
+    from consensusclustr_tpu.ops.pallas_cocluster import (
+        pad_labels_int8,
+        pallas_cocluster_rows,
+    )
+
+    r = np.random.default_rng(3)
+    n = 700
+    labels = r.integers(-1, 6, size=(10, n)).astype(np.int32)
+    assert (labels >= 0).any(axis=0).all()  # every cell sampled somewhere
+    n_pad = 768  # 3 * TILE
+    lab8 = pad_labels_int8(jnp.asarray(labels, jnp.int32), n_pad)
+    dense = _oracle(labels, 8)
+    for start in (0, 256, 512):
+        tile = np.asarray(
+            pallas_cocluster_rows(lab8, start, 256, 8, variant, True)
+        )[:, :n]
+        stop = min(start + 256, n)
+        np.testing.assert_array_equal(tile[: stop - start], dense[start:stop])
+
+
+@pytest.mark.parametrize("fn", ["knn", "pair_sums"])
+def test_blockwise_pallas_composition_matches_einsum(fn, monkeypatch):
+    """Full blockwise streamers with the Pallas tile (interpret mode) vs the
+    einsum tile: identical outputs, including top_k tie-breaking."""
+    from consensusclustr_tpu.consensus.blockwise import (
+        blockwise_consensus_knn,
+        cocluster_pair_sums,
+    )
+
+    monkeypatch.setenv("CCTPU_PALLAS_INTERPRET", "1")
+    r = np.random.default_rng(5)
+    n = 700
+    labels = jnp.asarray(r.integers(-1, 6, size=(12, n)).astype(np.int32))
+    if fn == "knn":
+        idx_p, d_p = blockwise_consensus_knn(labels, 10, 8, use_pallas=True)
+        idx_e, d_e = blockwise_consensus_knn(labels, 10, 8, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_e))
+        np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_e))
+    else:
+        codes = jnp.asarray(r.integers(0, 4, size=(n,)).astype(np.int32))
+        s_p, c_p = cocluster_pair_sums(labels, codes, 4, 8, use_pallas=True)
+        s_e, c_e = cocluster_pair_sums(labels, codes, 4, 8, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(s_p), np.asarray(s_e), atol=1e-3)
+        np.testing.assert_array_equal(np.asarray(c_p), np.asarray(c_e))
+
+
+def test_blockwise_tile_guards(monkeypatch):
+    """CCTPU_NO_PALLAS and the int8 bound beat the interpret override; a
+    non-TILE-multiple block fails loud instead of under-covering the output."""
+    from consensusclustr_tpu.consensus.blockwise import _pallas_tile_opts
+    from consensusclustr_tpu.ops.pallas_cocluster import (
+        pad_labels_int8,
+        pallas_cocluster_rows,
+    )
+
+    monkeypatch.setenv("CCTPU_PALLAS_INTERPRET", "1")
+    assert _pallas_tile_opts(True, 64)[0] is True
+    assert _pallas_tile_opts(True, 200)[0] is False      # int8 bound
+    monkeypatch.setenv("CCTPU_NO_PALLAS", "1")
+    assert _pallas_tile_opts(True, 64)[0] is False       # kill-switch wins
+    monkeypatch.delenv("CCTPU_NO_PALLAS")
+    monkeypatch.setenv("CCTPU_PALLAS_VARIANT", "mxv")
+    with pytest.raises(ValueError, match="variant"):
+        _pallas_tile_opts(True, 64)
+    monkeypatch.delenv("CCTPU_PALLAS_VARIANT")
+
+    lab8 = pad_labels_int8(jnp.zeros((4, 512), jnp.int32), 512)
+    with pytest.raises(ValueError, match="multiple of TILE"):
+        pallas_cocluster_rows(lab8, 0, 300, 8, "mxu", True)

@@ -1,0 +1,71 @@
+"""On-chip A/B for the blockwise consensus tile: Pallas rows kernel vs the
+einsum one-hot tile (consensus/blockwise.py; the n > 16k regime that carries
+the 50k north star — reference R/consensusClust.R:421's parDist pass).
+
+Run on the real chip when the tunnel is healthy:
+
+    python tools/tpu_blockwise_ab.py [n_cells] [n_boots]
+
+Each timed call is a full blockwise_consensus_knn (all row blocks, running
+top-k) with host fetch as the sync point. Also cross-checks the two paths'
+kNN indices for equality (the mxu tile is integer-exact, so the graphs must
+match exactly). Prints one JSON line at the end.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    # resolver, not jax.default_backend(): a cpu-pinned invocation must fail
+    # fast instead of dialing a possibly-wedged tunnel (utils/backend.py)
+    from consensusclustr_tpu.utils.backend import default_backend
+
+    import jax.numpy as jnp
+
+    backend = default_backend()
+    print(f"backend={backend}", flush=True)
+    if backend != "tpu":
+        print(json.dumps({"ok": False, "backend": backend,
+                          "error": "not on tpu; A/B would be meaningless"}))
+        return 1
+
+    from consensusclustr_tpu.consensus.blockwise import blockwise_consensus_knn
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    b = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    k = 20
+    rng = np.random.default_rng(0)
+    lab = jnp.asarray(rng.integers(-1, 24, size=(b, n)).astype(np.int32))
+
+    out: dict = {"cells": n, "boots": b, "k": k}
+    results = {}
+    for name, flag in (("pallas", True), ("einsum", False)):
+        t0 = time.time()
+        idx, dist = blockwise_consensus_knn(lab, k, 64, use_pallas=flag)
+        idx_h = np.asarray(idx)  # host fetch = real sync
+        out[f"{name}_cold_s"] = round(time.time() - t0, 3)
+        t0 = time.time()
+        idx, dist = blockwise_consensus_knn(lab, k, 64, use_pallas=flag)
+        idx_h = np.asarray(idx)
+        out[f"{name}_warm_s"] = round(time.time() - t0, 3)
+        results[name] = (idx_h, np.asarray(dist))
+        print(f"{name}: cold {out[f'{name}_cold_s']:.1f} s "
+              f"warm {out[f'{name}_warm_s']:.1f} s", flush=True)
+
+    idx_match = bool(np.array_equal(results["pallas"][0], results["einsum"][0]))
+    dist_diff = float(np.max(np.abs(results["pallas"][1] - results["einsum"][1])))
+    out["knn_idx_equal"] = idx_match
+    out["knn_dist_max_diff"] = dist_diff
+    out["ok"] = idx_match and dist_diff < 1e-5
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

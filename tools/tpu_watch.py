@@ -46,6 +46,10 @@ STEPS = [
     ("bench_10k",
      [sys.executable, os.path.join(REPO, "bench.py")],
      2700),
+    ("blockwise_ab_20k",
+     [sys.executable, os.path.join(REPO, "tools", "tpu_blockwise_ab.py"),
+      "20000", "24"],
+     1800),
     # last: the riskiest steps (longest single calls) — everything above has
     # already banked if one of these wedges the worker
     ("chunk_sweep",

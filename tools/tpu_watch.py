@@ -1,6 +1,7 @@
 """TPU healthy-window watcher: treat the flaky serving tunnel as an adversary.
 
-Polls the default backend in a killable subprocess; the moment a probe
+Maintains one patient backend probe (see PatientProbe: hung probes are left
+to run — killed workers are what wedge the tunnel); the moment a probe
 succeeds, runs the evidence suite step by step, banking each step's raw
 output under --outdir as it lands (so a window that closes mid-suite still
 leaves artifacts). Steps that fail or time out are retried at the next
@@ -64,16 +65,53 @@ STEPS = [
 ]
 
 
-def probe(timeout: int = 150) -> bool:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.default_backend() == 'tpu'"],
-            timeout=timeout, capture_output=True, cwd=REPO,
-        )
-        return proc.returncode == 0
-    except Exception:
-        return False
+class PatientProbe:
+    """One outstanding backend probe that is (almost) never killed.
+
+    The old 150 s-timeout probe KILLED its jax subprocess whenever backend
+    init was slow — and a killed worker is precisely the event that wedges
+    the serving tunnel (docs/perf.md). Polling that way every few minutes
+    can perpetuate the very wedge it is trying to detect the end of: r3/r4
+    saw zero healthy probes over whole rounds, while the one healthy window
+    of r5 arrived when nothing had been killed for hours (fresh container).
+
+    This probe lets the subprocess run as long as it needs; only if it
+    exceeds --probe-max-age (default 1 h) is it killed and restarted —
+    bounding the kill rate at ~1/hour instead of ~20/hour.
+    """
+
+    def __init__(self, max_age: int) -> None:
+        self.max_age = max_age
+        self.proc = None
+        self.started = 0.0
+
+    def poll(self):
+        """None = still waiting; True/False = probe finished (un)healthy."""
+        if self.proc is None:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; assert jax.default_backend() == 'tpu'"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                cwd=REPO,
+            )
+            self.started = time.time()
+            return None
+        rc = self.proc.poll()
+        if rc is not None:
+            self.proc = None
+            return rc == 0
+        if time.time() - self.started > self.max_age:
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=30)
+            except Exception:
+                pass
+            self.proc = None
+            return False
+        return None
+
+    def age(self) -> float:
+        return time.time() - self.started if self.proc is not None else 0.0
 
 
 def main() -> int:
@@ -87,6 +125,9 @@ def main() -> int:
                          "to hang on backend init (r5, t+03:48)")
     ap.add_argument("--done", action="append", default=[],
                     help="step name already banked this round; skip it")
+    ap.add_argument("--probe-max-age", type=int, default=3600,
+                    help="only kill a hung probe after this long (killed "
+                         "workers are what wedge the tunnel; see PatientProbe)")
     args = ap.parse_args()
     known = {s[0] for s in STEPS}
     unknown = [d for d in args.done if d not in known]
@@ -117,6 +158,7 @@ def main() -> int:
         ),
     )
 
+    prober = PatientProbe(args.probe_max_age)
     while time.time() - t_start < args.budget_secs:
         remaining = [s for s in STEPS if done.get(s[0]) != "ok"]
         if not remaining:
@@ -124,8 +166,16 @@ def main() -> int:
             print("tpu_watch: all evidence banked", flush=True)
             return 0
 
+        outcome = prober.poll()
+        if outcome is None:
+            if prober.age() > 60:  # don't spam for quick probes
+                print(f"tpu_watch: probe outstanding {prober.age():.0f}s "
+                      f"(t+{time.time()-t_start:.0f}s)", flush=True)
+            save_status("waiting")
+            time.sleep(min(args.poll_secs, 60))
+            continue
         probes += 1
-        healthy = probe()
+        healthy = outcome
         print(f"tpu_watch: probe #{probes} "
               f"{'HEALTHY' if healthy else 'wedged'} "
               f"(t+{time.time()-t_start:.0f}s)", flush=True)

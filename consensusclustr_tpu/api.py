@@ -554,11 +554,23 @@ def _level(
     any_small = bool((sizes < _GATE_SMALL_CLUSTER).any())  # quirk 7: "any"
     if n_real == n:
         sil_gate = cons.silhouette
+    elif not cfg.test_significance:
+        # the gate is disabled: don't pay a full silhouette pass over the
+        # real cells just to decide whether to log the skip event — treat
+        # the gate as firing (slightly over-logs on bucketed sub-levels)
+        sil_gate = -np.inf
     else:
         from consensusclustr_tpu.nulltest.splits import labelled_silhouette
 
         sil_gate = labelled_silhouette(pca[:n_real], labels_real, cfg.max_clusters)
-    if len(sizes) > 1 and (sil_gate <= cfg.silhouette_thresh or any_small):
+    gate_fires = len(sizes) > 1 and (
+        sil_gate <= cfg.silhouette_thresh or any_small
+    )
+    if not cfg.test_significance and gate_fires:
+        # only when a test was actually suppressed — a single cluster or a
+        # high-silhouette result would not have been tested anyway
+        log.event("null_test_skipped", reason="disabled by config")
+    if cfg.test_significance and gate_fires:
         if counts_hvg is None:
             log.event("null_test_skipped", reason="no raw counts available")
         else:

@@ -81,6 +81,11 @@ class ClusterConfig:
     silhouette_thresh: float = 0.45       # :126
     test_splits_separately: bool = False  # :125 (sic: reference spells it "seperately")
     n_null_sims: int = 20                 # :933 — per adaptive round
+    # No reference counterpart: skip the null-simulation gate entirely (the
+    # reference always tests when its :521 gate fires). For benchmark runs of
+    # the clustering core and for platforms where the vmapped null sims are
+    # impractical (a single 50k-cell sim measured ~40 min on 1 CPU core).
+    test_significance: bool = True
 
     # --- hierarchy / iteration (L7) -----------------------------------------
     iterate: bool = False                 # :122

@@ -279,3 +279,29 @@ def test_null_calibration_nb_noise_collapses():
         k_num=(10, 15), res_range=(0.05, 0.2, 0.6),
     )
     assert res.n_clusters == 1, set(res.assignments.tolist())
+
+
+def test_significance_gate_can_be_disabled():
+    """test_significance=False (no reference counterpart, documented in
+    config.py) skips the null-simulation gate entirely: well-separated blobs
+    keep their clusters and the run logs the skip reason instead of testing."""
+    from tests.conftest import make_blobs
+
+    from consensusclustr_tpu.api import consensus_clust
+
+    x, truth = make_blobs(n_per=40, n_clusters=3, sep=8.0, seed=3)
+    counts = np.maximum(np.round(np.exp(x / 4.0)), 0).astype(np.float32)
+    res = consensus_clust(
+        counts, nboots=4, pc_num=5, seed=1, test_significance=False,
+        silhouette_thresh=1.0,  # would force the gate if it were enabled
+        progress=True,
+    )
+    assert res.n_clusters >= 2
+    kinds = [r.get("kind") for r in res.log.records]
+    # the suppression is recorded, and no null test actually ran — this is
+    # what distinguishes disabled from "gate fired and tested significant"
+    assert "null_test_skipped" in kinds
+    skip = next(r for r in res.log.records if r["kind"] == "null_test_skipped")
+    assert skip["reason"] == "disabled by config"
+    assert not any(k and k.startswith("null_") and k != "null_test_skipped"
+                   for k in kinds)

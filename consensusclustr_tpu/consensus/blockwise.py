@@ -84,19 +84,25 @@ def _run_with_tile_fallback(jit_fn, arrays, static_tail, use_pallas, max_cluster
 
 
 def _make_tile(labels, n_pad, max_clusters, block, chunk, tile_impl, variant,
-               interpret):
-    """The [block, n_pad] distance-tile closure for the streaming loops."""
+               interpret, vma=()):
+    """tile(start) -> [block, n_pad] distance rows for the streaming loops.
+
+    ``start`` is the ABSOLUTE first row (traced ok) — shared by the
+    single-chip streamers (start = i * block) and the sharded kernel
+    (start = device_row0 + i * block). ``vma`` is forwarded to the pallas
+    rows kernel for shard_map callers that keep vma checking strict.
+    """
     if tile_impl == "pallas":
         from consensusclustr_tpu.ops.pallas_cocluster import (
             pad_labels_int8, pallas_cocluster_rows,
         )
 
         lab8 = pad_labels_int8(labels, n_pad)
-        return lambda i: pallas_cocluster_rows(
-            lab8, i * block, block, max_clusters, variant, interpret
+        return lambda start: pallas_cocluster_rows(
+            lab8, start, block, max_clusters, variant, interpret, vma=vma
         )
     labels_s = _onehot_chunks(labels, chunk, max_clusters)
-    return lambda i: _dist_tile(labels_s, i * block, block, max_clusters)
+    return lambda start: _dist_tile(labels_s, start, block, max_clusters)
 
 
 def _onehot_chunks(labels: jax.Array, chunk: int, max_clusters: int):
@@ -196,7 +202,7 @@ def _blockwise_knn_jit(
     rows_local = jnp.arange(block, dtype=jnp.int32)
 
     def one_block(i):
-        d = tile(i)[:, :n]                                            # [block, n]
+        d = tile(i * block)[:, :n]                                    # [block, n]
         r_global = i * block + rows_local
         self_col = jnp.clip(r_global, 0, n - 1)
         d = d.at[rows_local, self_col].set(jnp.inf)                   # exclude self
@@ -271,7 +277,7 @@ def _pair_sums_jit(
     rows_local = jnp.arange(block, dtype=jnp.int32)
 
     def one_block(acc, i):
-        d = tile(i)[:, :n]                                           # [block, n]
+        d = tile(i * block)[:, :n]                                   # [block, n]
         r_global = i * block + rows_local
         self_col = jnp.clip(r_global, 0, n - 1)
         d = d.at[rows_local, self_col].set(0.0)                      # diag 0

@@ -194,12 +194,21 @@ def _rect_call(
     variant: str,
     interpret: bool,
     zero_diag: bool,
+    vma: tuple = (),
 ) -> jax.Array:
     """[m_pad, n_pad] distance from padded int8 label tiles (shared core of
-    the square and rectangular entries)."""
+    the square and rectangular entries). ``vma`` names the mesh axes the
+    output varies over when called inside shard_map (pallas_call requires
+    the out_shape's varying axes to be declared explicitly)."""
     b_pad, m_pad = lab_rows8.shape
     _, n_pad = lab_cols8.shape
     boot_block = min(BOOT_BLOCK, b_pad)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct(
+            (m_pad, n_pad), jnp.float32, vma=frozenset(vma)
+        )
+    else:
+        out_shape = jax.ShapeDtypeStruct((m_pad, n_pad), jnp.float32)
 
     if variant == "mxu":
         kernel = functools.partial(
@@ -229,7 +238,7 @@ def _rect_call(
         out_specs=pl.BlockSpec(
             (TILE, TILE), lambda i, j, b: (i, j), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), jnp.float32),
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((TILE, TILE), scratch_dtype),
             pltpu.VMEM((TILE, TILE), scratch_dtype),
@@ -276,7 +285,8 @@ def pad_labels_int8(labels: jax.Array, n_pad: int) -> jax.Array:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "n_classes", "variant", "interpret")
+    jax.jit,
+    static_argnames=("block", "n_classes", "variant", "interpret", "vma"),
 )
 def pallas_cocluster_rows(
     lab8: jax.Array,
@@ -285,6 +295,7 @@ def pallas_cocluster_rows(
     n_classes: int = 128,
     variant: str = "mxu",
     interpret: bool = False,
+    vma: tuple = (),
 ) -> jax.Array:
     """[block, n_pad] co-clustering distance rows ``start .. start+block``
     against all cells — the blockwise consensus streamer's tile
@@ -306,7 +317,9 @@ def pallas_cocluster_rows(
     rows8 = jax.lax.dynamic_slice(
         lab8, (jnp.int32(0), jnp.asarray(start, jnp.int32)), (b_pad, block)
     )
-    return _rect_call(rows8, lab8, ncls, variant, interpret, zero_diag=False)
+    return _rect_call(
+        rows8, lab8, ncls, variant, interpret, zero_diag=False, vma=vma
+    )
 
 
 def pallas_coclustering_distance(

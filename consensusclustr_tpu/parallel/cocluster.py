@@ -33,6 +33,26 @@ from jax.sharding import PartitionSpec as P
 from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 
 
+def _sharded_tile_impl(max_clusters: int):
+    """Tile kernel choice for the sharded streamers: (impl, variant, interpret).
+
+    Opt-in via CCTPU_SHARDED_PALLAS=1 (plus CCTPU_PALLAS_INTERPRET=1 for the
+    CPU-mesh parity tests), conservative einsum default: unlike the
+    single-chip paths, a Mosaic failure inside the one fused sharded program
+    has no in-graph fallback — flip the default only after the sharded
+    composition has compiled on real multi-chip hardware. Resolved at trace
+    time; set the env before the first sharded call.
+    """
+    import os
+
+    if os.environ.get("CCTPU_SHARDED_PALLAS") != "1":
+        return ("einsum", "mxu", False)
+    from consensusclustr_tpu.consensus.blockwise import _pallas_tile_opts
+
+    pallas, variant, interpret = _pallas_tile_opts(True, max_clusters)
+    return ("pallas" if pallas else "einsum", variant, interpret)
+
+
 def _partial_counts(
     labels_local: jax.Array,   # [B_loc, n] int32, -1 = unsampled
     row_start: jax.Array,      # scalar int32: first row of this device's block
@@ -93,19 +113,26 @@ def sharded_blockwise_consensus_knn(
     owns n/D rows and streams [block, n] distance tiles from the replicated
     boot labels (consensus/blockwise.py tile kernel) past a local top-k.
     Returns (idx [n, k], dist [n, k]) sharded over the flattened axes; the
-    small [n, k] graph is then cheap to replicate. Requires n % D == 0.
+    small [n, k] graph is then cheap to replicate. Any n: the cell axis is
+    padded to the device count (x TILE for the opt-in Pallas tile,
+    CCTPU_SHARDED_PALLAS=1) with all -1 columns that always lose top_k ties.
     """
-    from consensusclustr_tpu.consensus.blockwise import (
-        _dist_tile,
-        _onehot_chunks,
-    )
+    from consensusclustr_tpu.consensus.blockwise import _make_tile
 
     b, n = labels.shape
     n_dev = mesh.shape[BOOT_AXIS] * mesh.shape[CELL_AXIS]
+    tile_impl, variant, interpret = _sharded_tile_impl(max_clusters)
     # pad the cell axis to the device count with all -1 columns: padded cells
     # sit at distance 1 from everything and always lose top_k ties to real
-    # cells (earliest-index tie-break), so they never contaminate real rows
-    n_pad = -(-n // n_dev) * n_dev
+    # cells (earliest-index tie-break), so they never contaminate real rows.
+    # The Pallas tile additionally needs TILE-aligned per-device row blocks.
+    if tile_impl == "pallas":
+        from consensusclustr_tpu.ops.pallas_cocluster import TILE
+
+        align = n_dev * TILE
+    else:
+        align = n_dev
+    n_pad = -(-n // align) * align
     if n_pad != n:
         labels = jnp.concatenate(
             [jnp.asarray(labels, jnp.int32),
@@ -113,21 +140,34 @@ def sharded_blockwise_consensus_knn(
         )
     n_rows = n_pad // n_dev
     k_eff = min(k, n - 1)
-    blk = min(block, n_rows)
-    while n_rows % blk:  # largest divisor of the per-device rows <= block
-        blk -= 1
+    if tile_impl == "pallas":
+        # largest TILE-multiple divisor of the per-device rows <= block
+        m = n_rows // TILE
+        bmax = max(block // TILE, 1)
+        d = min(bmax, m)
+        while m % d:
+            d -= 1
+        blk = d * TILE
+    else:
+        blk = min(block, n_rows)
+        while n_rows % blk:  # largest divisor of the per-device rows <= block
+            blk -= 1
 
     def kernel(labels_rep):
         i_boot = jax.lax.axis_index(BOOT_AXIS)
         i_cell = jax.lax.axis_index(CELL_AXIS)
         dev = i_boot * mesh.shape[CELL_AXIS] + i_cell
         row0 = (dev * n_rows).astype(jnp.int32)
-        labels_s = _onehot_chunks(labels_rep, chunk, max_clusters)
         rows_local = jnp.arange(blk, dtype=jnp.int32)
+        tile = _make_tile(
+            labels_rep, n_pad, max_clusters, blk, chunk, tile_impl, variant,
+            interpret,
+            vma=(BOOT_AXIS, CELL_AXIS) if not interpret else (),
+        )
 
         def one_block(i):
             start = row0 + i * blk
-            d = _dist_tile(labels_s, start, blk, max_clusters)   # [blk, n_pad]
+            d = tile(start)                                      # [blk, n_pad]
             self_col = jnp.clip(start + rows_local, 0, n_pad - 1)
             d = d.at[rows_local, self_col].set(jnp.inf)
             return jax.lax.top_k(-d, k_eff)
@@ -136,11 +176,17 @@ def sharded_blockwise_consensus_knn(
         return idx.reshape(n_rows, k_eff), -neg.reshape(n_rows, k_eff)
 
     both = (BOOT_AXIS, CELL_AXIS)
+    # the pallas tile's INTERPRET-mode lowering cannot yet propagate varying
+    # manual axes through its internal grid scan (jax asks for an upstream
+    # issue and suggests exactly this workaround), so only the interpret
+    # test path relaxes vma checking; the einsum default and the hardware
+    # pallas path (which declares its vma on the out_shape) stay strict
     idx, dist = jax.shard_map(
         kernel,
         mesh=mesh,
         in_specs=P(None, None),
         out_specs=(P(both, None), P(both, None)),
+        check_vma=not (tile_impl == "pallas" and interpret),
     )(jnp.asarray(labels, jnp.int32))
     idx, dist = idx[:n], dist[:n]
     if k_eff < k:

@@ -245,3 +245,42 @@ def test_euclidean_cluster_distance_matches_dense():
     got = euclidean_cluster_distance(x, codes, block=128)
     off = ~np.eye(4, dtype=bool)
     np.testing.assert_allclose(got[off], want[off], rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_blockwise_knn_pallas_tile_matches_einsum(monkeypatch):
+    """Opt-in sharded Pallas tile (CCTPU_SHARDED_PALLAS=1, interpret mode on
+    the CPU mesh): identical kNN graph to the sharded einsum tile. The env is
+    resolved at trace time, so the caches are cleared between legs and a spy
+    proves the Pallas composition actually ran (same input shape would
+    otherwise silently reuse the einsum executable)."""
+    from consensusclustr_tpu.ops import pallas_cocluster as pc
+    from consensusclustr_tpu.parallel.cocluster import (
+        sharded_blockwise_consensus_knn,
+    )
+    from consensusclustr_tpu.parallel.mesh import consensus_mesh
+
+    labels, _ = _boot_labels(n=700, seed=7)
+    mesh = consensus_mesh(boot=4, cell=2)
+    idx_e, d_e = sharded_blockwise_consensus_knn(
+        jnp.asarray(labels), mesh, 10, max_clusters=8
+    )
+    monkeypatch.setenv("CCTPU_SHARDED_PALLAS", "1")
+    monkeypatch.setenv("CCTPU_PALLAS_INTERPRET", "1")
+    calls = []
+    real_rows = pc.pallas_cocluster_rows
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real_rows(*a, **kw)
+
+    monkeypatch.setattr(pc, "pallas_cocluster_rows", spy)
+    jax.clear_caches()  # force a retrace so the env choice is re-resolved
+    idx_p, d_p = sharded_blockwise_consensus_knn(
+        jnp.asarray(labels), mesh, 10, max_clusters=8
+    )
+    assert calls, "pallas tile was never traced"
+    np.testing.assert_array_equal(np.asarray(idx_p), np.asarray(idx_e))
+    np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_e))
+    # don't leave a pallas-interpret executable cached for later tests with
+    # the same shapes/statics after the env pins are restored
+    jax.clear_caches()

@@ -37,6 +37,14 @@ import traceback
 
 import numpy as np
 
+# Schema version stamped on every JSON line (with the per-phase seconds) so
+# BENCH_*.json trajectories stay machine-comparable across PRs. Guarded: the
+# failure rung must emit even if the package itself cannot import.
+try:
+    from consensusclustr_tpu.obs.schema import SCHEMA_VERSION as _OBS_SCHEMA
+except Exception:
+    _OBS_SCHEMA = 0
+
 # In-script CPU forcing (retry path): with a wedged serving tunnel the
 # JAX_PLATFORMS env var hangs the interpreter inside the PJRT registration
 # hook, but selecting the platform through the live config works.
@@ -86,6 +94,12 @@ def _run_pbmc3k() -> dict:
     s_ij = comb(ct).sum(); s_a = comb(ct.sum(1)).sum(); s_b = comb(ct.sum(0)).sum()
     tot = comb(len(codes)); exp = s_a * s_b / tot; mx = 0.5 * (s_a + s_b)
     ari = float((s_ij - exp) / (mx - exp)) if mx != exp else 1.0
+    # per-phase breakdown straight from the run's RunRecord (obs/)
+    phases = (
+        {k: round(v, 3) for k, v in res.run_record.phase_seconds().items()}
+        if res.run_record is not None
+        else {}
+    )
     return {
         "metric": f"pbmc3k e2e wall ({nboots} boots, pcNum=5)",
         "value": round(dt, 2),
@@ -96,6 +110,8 @@ def _run_pbmc3k() -> dict:
         "n_clusters": int(res.n_clusters),
         "ari_vs_truth": round(ari, 4),
         "boots_per_sec": round(nboots / dt, 3),
+        "phases": phases,
+        "obs_schema": _OBS_SCHEMA,
     }
 
 
@@ -110,6 +126,8 @@ def _run_granular() -> dict:
 
     from consensusclustr_tpu.config import ClusterConfig
     from consensusclustr_tpu.consensus.pipeline import consensus_cluster
+    from consensusclustr_tpu.obs import Tracer
+    from consensusclustr_tpu.utils.log import LevelLog
     from consensusclustr_tpu.utils.rng import root_key
 
     backend = jax.default_backend()
@@ -134,8 +152,9 @@ def _run_granular() -> dict:
 
     key = root_key(123)
     pca_dev = jnp.asarray(pca)
+    tracer = Tracer()
     t0 = time.perf_counter()
-    res = consensus_cluster(key, pca_dev, cfg)
+    res = consensus_cluster(key, pca_dev, cfg, log=LevelLog(tracer=tracer))
     dt = time.perf_counter() - t0
     return {
         "metric": (
@@ -152,6 +171,8 @@ def _run_granular() -> dict:
         "boots_per_sec": round(nboots / dt, 3),
         "candidate_rows": b_eff,
         "n_clusters": int(res.n_clusters),
+        "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
+        "obs_schema": _OBS_SCHEMA,
     }
 
 
@@ -171,9 +192,11 @@ def _run() -> dict:
     from consensusclustr_tpu import consensus as _  # noqa: F401  (import check)
     from consensusclustr_tpu.config import ClusterConfig
     from consensusclustr_tpu.consensus import cocluster as cocluster_mod
+    from consensusclustr_tpu.obs import Tracer
     from consensusclustr_tpu.ops import pallas_cocluster as _pallas_mod
     from consensusclustr_tpu.consensus.cocluster import coclustering_distance
     from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+    from consensusclustr_tpu.utils.log import LevelLog
     from consensusclustr_tpu.utils.rng import root_key
 
     backend = jax.default_backend()
@@ -196,18 +219,24 @@ def _run() -> dict:
     key = root_key(123)
     pca_dev = jnp.asarray(pca)
 
-    def run():
-        labels, _ = run_bootstraps(key, pca_dev, cfg)
-        dist = coclustering_distance(
-            jnp.asarray(labels, jnp.int32), cfg.max_clusters,
-            use_pallas=cfg.use_pallas,
-        )
+    def run(tracer):
+        # spans cover the whole timed region: "boots" opens inside
+        # run_bootstraps, "cocluster" here — so the emitted phases dict
+        # accounts for (within rounding) all of wall_s
+        labels, _ = run_bootstraps(key, pca_dev, cfg, LevelLog(tracer=tracer))
+        with tracer.span("cocluster") as sp:
+            dist = coclustering_distance(
+                jnp.asarray(labels, jnp.int32), cfg.max_clusters,
+                use_pallas=cfg.use_pallas,
+            )
+            sp.value = dist
         return jax.block_until_ready(dist)
 
-    run()  # warmup: compiles the exact chunk shapes the timed run uses
+    run(Tracer())  # warmup: compiles the exact chunk shapes the timed run uses
 
+    tracer = Tracer()
     t0 = time.perf_counter()
-    run()
+    run(tracer)
     dt = time.perf_counter() - t0
     boots_per_sec = nboots / dt
     # snapshot BEFORE the parity block below: its small dispatch also sets
@@ -248,6 +277,8 @@ def _run() -> dict:
         "cells": n,
         "boots": nboots,
         "wall_s": round(dt, 3),
+        "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
+        "obs_schema": _OBS_SCHEMA,
     }
 
 
@@ -378,6 +409,9 @@ def main() -> None:
             "unit": "boots/s",
             "vs_baseline": 0.0,
             "error": err.strip().splitlines()[-1][:300],
+            # failure rung stays schema-comparable: empty phases, same keys
+            "phases": {},
+            "obs_schema": _OBS_SCHEMA,
         }
     )
 

@@ -22,6 +22,7 @@ the package has no hard anndata dependency).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -29,6 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.obs import (
+    RunRecord,
+    Tracer,
+    maybe_span,
+    record_device_memory,
+)
 from consensusclustr_tpu.consensus.pipeline import ConsensusResult, consensus_cluster
 from consensusclustr_tpu.hierarchy.clustree import hierarchy_edges, hierarchy_table
 from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
@@ -67,6 +74,9 @@ class ClusterResult:
     clustree: Optional[Dict[str, np.ndarray]] = None
     clustree_edges: Optional[List[tuple]] = None
     log: Optional[LevelLog] = None
+    # Observability: span tree + events + metrics for this run (obs/).
+    # Serialize with run_record.write(path); render with tools/report.py.
+    run_record: Optional[RunRecord] = None
 
     @property
     def n_clusters(self) -> int:
@@ -355,7 +365,21 @@ def _level(
     depth: int,
 ) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray]]:
     """One level of the pipeline (reference :274-539): returns
-    (labels [n] of str, consensus result or None, pca or None)."""
+    (labels [n] of str, consensus result or None, pca or None).
+
+    Span-wrapped: each level is one "level" span; recursion nests child
+    levels under the parent's tree in the RunRecord."""
+    with maybe_span(log, "level", depth=depth):
+        return _level_impl(key, ing, cfg, log, depth)
+
+
+def _level_impl(
+    key: jax.Array,
+    ing: _Ingested,
+    cfg: ClusterConfig,
+    log: LevelLog,
+    depth: int,
+) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray]]:
     n = (
         ing.counts.shape[0]
         if ing.counts is not None
@@ -389,158 +413,162 @@ def _level(
         and int(cfg.pc_num) <= 30
     )
 
-    # --- normalise (:274-288) ---------------------------------------------
-    if use_given_pca:
-        norm = None
-    elif ing.norm_counts is not None:
-        norm = (
-            ing.norm_counts
-            if _is_sparse(ing.norm_counts)
-            else jnp.asarray(ing.norm_counts, jnp.float32)
+    with maybe_span(log, "prep"):
+        # --- normalise (:274-288) ---------------------------------------------
+        if use_given_pca:
+            norm = None
+        elif ing.norm_counts is not None:
+            norm = (
+                ing.norm_counts
+                if _is_sparse(ing.norm_counts)
+                else jnp.asarray(ing.norm_counts, jnp.float32)
+            )
+        else:
+            if ing.counts is None:
+                raise ValueError(
+                    "need counts or norm_counts (or a precomputed pca with a "
+                    "numeric pc_num <= 30)"
+                )
+            if sparse_counts:
+                from consensusclustr_tpu.prep.sparse import (
+                    compute_size_factors_sparse,
+                    sparse_shifted_log,
+                )
+
+                sf_np = compute_size_factors_sparse(ing.counts, cfg.size_factors)
+                sf = jnp.asarray(sf_np)
+                norm = sparse_shifted_log(ing.counts, sf_np)  # stays CSR
+            else:
+                sf = compute_size_factors(counts_dev, cfg.size_factors)
+                norm = shifted_log(counts_dev, sf)
+
+        # --- HVG selection (:291-304) -----------------------------------------
+        n_genes = ing.counts.shape[1] if ing.counts is not None else (
+            norm.shape[1] if norm is not None else 0
         )
-    else:
-        if ing.counts is None:
-            raise ValueError(
-                "need counts or norm_counts (or a precomputed pca with a "
-                "numeric pc_num <= 30)"
-            )
-        if sparse_counts:
-            from consensusclustr_tpu.prep.sparse import (
-                compute_size_factors_sparse,
-                sparse_shifted_log,
-            )
+        hvg_mask = _resolve_hvg_mask(ing.variable_features, ing.gene_names, n_genes)
+        if hvg_mask is None and not ing.scale_data and ing.counts is not None:
+            n_hvg = min(cfg.n_var_features, n_genes)
+            if sparse_counts:
+                from consensusclustr_tpu.prep.sparse import sparse_select_hvgs
 
-            sf_np = compute_size_factors_sparse(ing.counts, cfg.size_factors)
-            sf = jnp.asarray(sf_np)
-            norm = sparse_shifted_log(ing.counts, sf_np)  # stays CSR
+                hvg_mask = sparse_select_hvgs(ing.counts, n_hvg)
+            else:
+                hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
+        if hvg_mask is not None:
+            mask_np = np.asarray(hvg_mask)
+            if norm is not None and not ing.scale_data:
+                # scale.data input skips the norm HVG subset — Seurat already did
+                # (:301); the null-test counts are HVG-subset either way (:526)
+                norm = norm[:, mask_np]
+            counts_hvg = _dense_cols(ing.counts, mask_np) if ing.counts is not None else None
         else:
-            sf = compute_size_factors(counts_dev, cfg.size_factors)
-            norm = shifted_log(counts_dev, sf)
-
-    # --- HVG selection (:291-304) -----------------------------------------
-    n_genes = ing.counts.shape[1] if ing.counts is not None else (
-        norm.shape[1] if norm is not None else 0
-    )
-    hvg_mask = _resolve_hvg_mask(ing.variable_features, ing.gene_names, n_genes)
-    if hvg_mask is None and not ing.scale_data and ing.counts is not None:
-        n_hvg = min(cfg.n_var_features, n_genes)
-        if sparse_counts:
-            from consensusclustr_tpu.prep.sparse import sparse_select_hvgs
-
-            hvg_mask = sparse_select_hvgs(ing.counts, n_hvg)
-        else:
-            hvg_mask = np.asarray(select_hvgs(counts_dev, n_hvg))
-    if hvg_mask is not None:
-        mask_np = np.asarray(hvg_mask)
-        if norm is not None and not ing.scale_data:
-            # scale.data input skips the norm HVG subset — Seurat already did
-            # (:301); the null-test counts are HVG-subset either way (:526)
-            norm = norm[:, mask_np]
-        counts_hvg = _dense_cols(ing.counts, mask_np) if ing.counts is not None else None
-    else:
-        counts_hvg = _dense_cols(ing.counts, None) if ing.counts is not None else None
-    # the dense device path starts here: post-HVG the matrix is
-    # [n, n_var_features] and safely materialisable
-    if _is_sparse(norm):
-        norm = jnp.asarray(np.asarray(norm.todense(), np.float32))
-    log.event("prep", n_genes_kept=int(norm.shape[1]) if norm is not None else 0)
+            counts_hvg = _dense_cols(ing.counts, None) if ing.counts is not None else None
+        # the dense device path starts here: post-HVG the matrix is
+        # [n, n_var_features] and safely materialisable
+        if _is_sparse(norm):
+            norm = jnp.asarray(np.asarray(norm.todense(), np.float32))
+        log.event("prep", n_genes_kept=int(norm.shape[1]) if norm is not None else 0)
 
     # --- covariate regression (:306-319) ----------------------------------
     skip_here = (
         depth == 1 and _skip_first_regression(cfg, ing)
     ) or ing.scale_data  # Seurat scale.data is already regressed (:314-319)
     if ing.covariates is not None and norm is not None and not skip_here:
-        counts_for_glm = (
-            jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None
-        )
-        sf_glm = sf
-        if (
-            sf_glm is None
-            and counts_for_glm is not None
-            and cfg.regress_method in ("glmGamPoi", "poisson")
-        ):
-            # norm was supplied pre-normalised, so no size factors were
-            # computed this level; the GLM paths still need a depth offset
-            # (docs/quirks.md D9) — derive library-size factors.
-            if sparse_counts:
-                from consensusclustr_tpu.prep.sparse import (
-                    compute_size_factors_sparse,
-                )
+        with maybe_span(log, "regress"):
+            counts_for_glm = (
+                jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None
+            )
+            sf_glm = sf
+            if (
+                sf_glm is None
+                and counts_for_glm is not None
+                and cfg.regress_method in ("glmGamPoi", "poisson")
+            ):
+                # norm was supplied pre-normalised, so no size factors were
+                # computed this level; the GLM paths still need a depth offset
+                # (docs/quirks.md D9) — derive library-size factors.
+                if sparse_counts:
+                    from consensusclustr_tpu.prep.sparse import (
+                        compute_size_factors_sparse,
+                    )
 
-                sf_glm = jnp.asarray(
-                    compute_size_factors_sparse(ing.counts, "libsize")
-                )
-            else:
-                sf_glm = compute_size_factors(counts_dev, "libsize")
-        norm = regress_features(
-            norm, jnp.asarray(ing.covariates, jnp.float32),
-            counts=counts_for_glm, method=cfg.regress_method,
-            size_factors=sf_glm,
-        )
-        log.event("regressed", method=cfg.regress_method)
+                    sf_glm = jnp.asarray(
+                        compute_size_factors_sparse(ing.counts, "libsize")
+                    )
+                else:
+                    sf_glm = compute_size_factors(counts_dev, "libsize")
+            norm = regress_features(
+                norm, jnp.asarray(ing.covariates, jnp.float32),
+                counts=counts_for_glm, method=cfg.regress_method,
+                size_factors=sf_glm,
+            )
+            log.event("regressed", method=cfg.regress_method)
 
     # --- PCA + pcNum (:321-382) -------------------------------------------
     # The elbow prompt covers both "find" and the numeric pc_num > 30 case —
     # the latter silently re-enters the find path (reference :338, quirk 3),
     # so an interactive user should get the same say over the outcome.
-    wants_find = cfg.pc_num == "find" or (
-        not isinstance(cfg.pc_num, str) and int(cfg.pc_num) > 30
-    )
-    if (
-        cfg.interactive
-        and depth == 1
-        and wants_find
-        and norm is not None
-        and not use_given_pca
-    ):
-        chosen = _interactive_pc_num(norm, cfg, key)
-        if chosen is not None:
-            cfg = cfg.replace(pc_num=chosen)
-            log.event("interactive_pc_num", pc_num=chosen)
-    if use_given_pca:
-        pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
-        pca = np.asarray(ing.pca[:, :pc_num], np.float32)
-    else:
-        try:
-            scores, pc_num, _ = pca_for_config(
-                norm, cfg.pc_num, cfg.pc_var,
-                center=cfg.center, scale=cfg.scale,
-                key=cluster_key(key, "pca"),
-                counts=(jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None),
-                size_factors=sf,
-                design=(
-                    jnp.asarray(ing.covariates, jnp.float32)
-                    if ing.covariates is not None
-                    else None
-                ),
-            )
-            pca = np.asarray(scores)
-        except Exception as e:  # PCA failure => single cluster (:368-379)
-            log.event("pca_failed", error=str(e))
-            return _single_cluster(n), None, None
-        if not np.all(np.isfinite(pca)):
-            log.event("pca_failed", error="non-finite scores")
-            return _single_cluster(n), None, None
-    # Shape bucketing of the PC axis (SURVEY §7.3 item 2): pad to a multiple
-    # of 4 with zero columns — inert for every distance/silhouette downstream
-    # (exact), but subproblems with nearby elbow choices share jit caches.
-    # pc_num itself stays UNpadded: the null sims extract pc_num genuine PCs
-    # from simulated data, so feeding them the padded width would compare an
-    # effectively lower-dimensional observed statistic against a higher-
-    # dimensional null — anti-conservative. Only the boot grid (the hot
-    # path) sees the bucketed width.
-    if cfg.shape_buckets and depth > 1:
-        d_pad = -(-int(pc_num) // 4) * 4
-        pca = np.asarray(pca, np.float32)
-        if d_pad != pca.shape[1]:
-            pca = np.concatenate(
-                [pca, np.zeros((pca.shape[0], d_pad - pca.shape[1]), np.float32)],
-                axis=1,
-            )
-    log.event("pca", pc_num=int(pc_num))
+    with maybe_span(log, "pca"):
+        wants_find = cfg.pc_num == "find" or (
+            not isinstance(cfg.pc_num, str) and int(cfg.pc_num) > 30
+        )
+        if (
+            cfg.interactive
+            and depth == 1
+            and wants_find
+            and norm is not None
+            and not use_given_pca
+        ):
+            chosen = _interactive_pc_num(norm, cfg, key)
+            if chosen is not None:
+                cfg = cfg.replace(pc_num=chosen)
+                log.event("interactive_pc_num", pc_num=chosen)
+        if use_given_pca:
+            pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
+            pca = np.asarray(ing.pca[:, :pc_num], np.float32)
+        else:
+            try:
+                scores, pc_num, _ = pca_for_config(
+                    norm, cfg.pc_num, cfg.pc_var,
+                    center=cfg.center, scale=cfg.scale,
+                    key=cluster_key(key, "pca"),
+                    counts=(jnp.asarray(counts_hvg, jnp.float32) if counts_hvg is not None else None),
+                    size_factors=sf,
+                    design=(
+                        jnp.asarray(ing.covariates, jnp.float32)
+                        if ing.covariates is not None
+                        else None
+                    ),
+                )
+                pca = np.asarray(scores)
+            except Exception as e:  # PCA failure => single cluster (:368-379)
+                log.event("pca_failed", error=str(e))
+                return _single_cluster(n), None, None
+            if not np.all(np.isfinite(pca)):
+                log.event("pca_failed", error="non-finite scores")
+                return _single_cluster(n), None, None
+        # Shape bucketing of the PC axis (SURVEY §7.3 item 2): pad to a multiple
+        # of 4 with zero columns — inert for every distance/silhouette downstream
+        # (exact), but subproblems with nearby elbow choices share jit caches.
+        # pc_num itself stays UNpadded: the null sims extract pc_num genuine PCs
+        # from simulated data, so feeding them the padded width would compare an
+        # effectively lower-dimensional observed statistic against a higher-
+        # dimensional null — anti-conservative. Only the boot grid (the hot
+        # path) sees the bucketed width.
+        if cfg.shape_buckets and depth > 1:
+            d_pad = -(-int(pc_num) // 4) * 4
+            pca = np.asarray(pca, np.float32)
+            if d_pad != pca.shape[1]:
+                pca = np.concatenate(
+                    [pca, np.zeros((pca.shape[0], d_pad - pca.shape[1]), np.float32)],
+                    axis=1,
+                )
+        log.event("pca", pc_num=int(pc_num))
 
     # --- consensus clustering (L5, :388-511) ------------------------------
-    cons = consensus_cluster(cluster_key(key, "consensus"), pca, cfg, log=log)
+    with maybe_span(log, "consensus"):
+        cons = consensus_cluster(cluster_key(key, "consensus"), pca, cfg, log=log)
     labels = np.asarray([str(l + 1) for l in cons.labels], dtype=object)
 
     # --- significance gate (:514-539) -------------------------------------
@@ -548,80 +576,81 @@ def _level(
     # cells: duplicate rows would inflate cluster sizes and silhouettes,
     # bypassing tests that the unpadded subproblem would run. The test's
     # outcome is a per-cluster label mapping, so it extends to duplicates.
-    n_real = int(cfg.n_real_cells) if cfg.n_real_cells else n
-    labels_real = labels[:n_real]
-    sizes = np.unique(labels_real, return_counts=True)[1]
-    any_small = bool((sizes < _GATE_SMALL_CLUSTER).any())  # quirk 7: "any"
-    if n_real == n:
-        sil_gate = cons.silhouette
-    elif not cfg.test_significance:
-        # the gate is disabled: don't pay a full silhouette pass over the
-        # real cells just to decide whether to log the skip event — treat
-        # the gate as firing (slightly over-logs on bucketed sub-levels)
-        sil_gate = -np.inf
-    else:
-        from consensusclustr_tpu.nulltest.splits import labelled_silhouette
-
-        sil_gate = labelled_silhouette(pca[:n_real], labels_real, cfg.max_clusters)
-    gate_fires = len(sizes) > 1 and (
-        sil_gate <= cfg.silhouette_thresh or any_small
-    )
-    if not cfg.test_significance and gate_fires:
-        # only when a test was actually suppressed — a single cluster or a
-        # high-silhouette result would not have been tested anyway
-        log.event("null_test_skipped", reason="disabled by config")
-    if cfg.test_significance and gate_fires:
-        if counts_hvg is None:
-            log.event("null_test_skipped", reason="no raw counts available")
+    with maybe_span(log, "significance"):
+        n_real = int(cfg.n_real_cells) if cfg.n_real_cells else n
+        labels_real = labels[:n_real]
+        sizes = np.unique(labels_real, return_counts=True)[1]
+        any_small = bool((sizes < _GATE_SMALL_CLUSTER).any())  # quirk 7: "any"
+        if n_real == n:
+            sil_gate = cons.silhouette
+        elif not cfg.test_significance:
+            # the gate is disabled: don't pay a full silhouette pass over the
+            # real cells just to decide whether to log the skip event — treat
+            # the gate as firing (slightly over-logs on bucketed sub-levels)
+            sil_gate = -np.inf
         else:
-            # gate on n_real, not the bucket-padded count: the dendrogram
-            # below is built on pca[:n_real] (ADVICE r3)
-            dense_gate = (
-                cfg.dense_consensus
-                if cfg.dense_consensus is not None
-                else n_real <= _DENSE_GATE_LIMIT
-            )
-            if dense_gate:
-                dend = determine_hierarchy(_euclidean(pca[:n_real]), labels_real)
-            else:
-                # scale regime: the gate's PCA-distance dendrogram (:523)
-                # streams cluster-pair sums instead of the [n, n] matrix
-                from consensusclustr_tpu.consensus.blockwise import (
-                    euclidean_cluster_distance,
-                )
-                from consensusclustr_tpu.hierarchy.dendro import (
-                    _sorted_unique,
-                    dendrogram_from_cluster_distance,
-                )
+            from consensusclustr_tpu.nulltest.splits import labelled_silhouette
 
-                uniq = _sorted_unique(labels_real)
-                code_of = {u: i for i, u in enumerate(uniq)}
-                codes = np.asarray([code_of[l] for l in labels_real], np.int32)
-                cmat = euclidean_cluster_distance(pca[:n_real], codes)
-                dend = dendrogram_from_cluster_distance(cmat, uniq)
-            tested = test_splits(
-                counts_hvg[:n_real], pca[:n_real], dend, labels_real,
-                pc_num=int(pc_num), k_num=cfg.k_num, alpha=cfg.alpha,
-                silhouette_thresh=cfg.silhouette_thresh,
-                covariates=(
-                    ing.covariates[:n_real]
-                    if ing.covariates is not None
-                    else None
-                ),
-                n_sims=cfg.n_null_sims,
-                key=cluster_key(key, "nulltest"),
-                test_separately=cfg.test_splits_separately,
-                max_clusters=cfg.max_clusters, log=log,
-                cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
-            )
-            # merges act on whole clusters, so the outcome is a label map
-            mapping = {}
-            for old, new in zip(labels_real, tested):
-                mapping.setdefault(old, new)
-            labels = np.asarray(
-                [mapping.get(l, l) for l in labels], dtype=object
-            )
-            labels = _relabel(labels)
+            sil_gate = labelled_silhouette(pca[:n_real], labels_real, cfg.max_clusters)
+        gate_fires = len(sizes) > 1 and (
+            sil_gate <= cfg.silhouette_thresh or any_small
+        )
+        if not cfg.test_significance and gate_fires:
+            # only when a test was actually suppressed — a single cluster or a
+            # high-silhouette result would not have been tested anyway
+            log.event("null_test_skipped", reason="disabled by config")
+        if cfg.test_significance and gate_fires:
+            if counts_hvg is None:
+                log.event("null_test_skipped", reason="no raw counts available")
+            else:
+                # gate on n_real, not the bucket-padded count: the dendrogram
+                # below is built on pca[:n_real] (ADVICE r3)
+                dense_gate = (
+                    cfg.dense_consensus
+                    if cfg.dense_consensus is not None
+                    else n_real <= _DENSE_GATE_LIMIT
+                )
+                if dense_gate:
+                    dend = determine_hierarchy(_euclidean(pca[:n_real]), labels_real)
+                else:
+                    # scale regime: the gate's PCA-distance dendrogram (:523)
+                    # streams cluster-pair sums instead of the [n, n] matrix
+                    from consensusclustr_tpu.consensus.blockwise import (
+                        euclidean_cluster_distance,
+                    )
+                    from consensusclustr_tpu.hierarchy.dendro import (
+                        _sorted_unique,
+                        dendrogram_from_cluster_distance,
+                    )
+
+                    uniq = _sorted_unique(labels_real)
+                    code_of = {u: i for i, u in enumerate(uniq)}
+                    codes = np.asarray([code_of[l] for l in labels_real], np.int32)
+                    cmat = euclidean_cluster_distance(pca[:n_real], codes)
+                    dend = dendrogram_from_cluster_distance(cmat, uniq)
+                tested = test_splits(
+                    counts_hvg[:n_real], pca[:n_real], dend, labels_real,
+                    pc_num=int(pc_num), k_num=cfg.k_num, alpha=cfg.alpha,
+                    silhouette_thresh=cfg.silhouette_thresh,
+                    covariates=(
+                        ing.covariates[:n_real]
+                        if ing.covariates is not None
+                        else None
+                    ),
+                    n_sims=cfg.n_null_sims,
+                    key=cluster_key(key, "nulltest"),
+                    test_separately=cfg.test_splits_separately,
+                    max_clusters=cfg.max_clusters, log=log,
+                    cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
+                )
+                # merges act on whole clusters, so the outcome is a label map
+                mapping = {}
+                for old, new in zip(labels_real, tested):
+                    mapping.setdefault(old, new)
+                labels = np.asarray(
+                    [mapping.get(l, l) for l in labels], dtype=object
+                )
+                labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))
     return labels, cons, pca
 
@@ -749,53 +778,78 @@ def consensus_clust(
     Returns ClusterResult(assignments, cluster_dendrogram, clustree) per the
     reference's result contract (SURVEY §8.3).
     """
+    from consensusclustr_tpu.utils.backend import default_backend
     from consensusclustr_tpu.utils.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()
     cfg = (config or ClusterConfig()).replace(**params) if params else (config or ClusterConfig())
-    log = LevelLog(enabled=cfg.progress)
+    # CCTPU_SPAN_ANNOTATE=1 mirrors every span into a
+    # jax.profiler.TraceAnnotation so the phase names appear inside device
+    # traces captured with utils.profiling.device_trace.
+    tracer = Tracer(
+        progress=cfg.progress,
+        annotate=bool(os.environ.get("CCTPU_SPAN_ANNOTATE")),
+    )
+    log = LevelLog(enabled=cfg.progress, tracer=tracer)
     key = root_key(cfg.seed)
 
-    ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
+    with tracer.span("ingest"):
+        ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
     labels, cons, pca_used = _level(key, ing, cfg, log, depth=cfg.depth)
     n = len(labels)
 
     if cfg.iterate and len(set(labels.tolist())) > 1 and ing.counts is not None:
-        labels = _iterate(key, ing.counts, ing.covariates, labels, cfg, log, cfg.depth)
+        with tracer.span("iterate"):
+            labels = _iterate(
+                key, ing.counts, ing.covariates, labels, cfg, log, cfg.depth
+            )
 
     # --- output assembly at depth 1 (:580-632) ----------------------------
-    dend = None
-    if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
-        if cons.jaccard_dist is not None:
-            dend = determine_hierarchy(cons.jaccard_dist, labels)
-        elif cons.boot_labels is not None:
-            # blockwise regime: the cell-cell matrix never existed; stream
-            # the cluster-pair mean co-clustering distances instead (:621)
-            from consensusclustr_tpu.consensus.blockwise import (
-                cocluster_cluster_distance,
-            )
-            from consensusclustr_tpu.hierarchy.dendro import (
-                _sorted_unique,
-                dendrogram_from_cluster_distance,
-            )
+    with tracer.span("assemble"):
+        dend = None
+        if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
+            if cons.jaccard_dist is not None:
+                dend = determine_hierarchy(cons.jaccard_dist, labels)
+            elif cons.boot_labels is not None:
+                # blockwise regime: the cell-cell matrix never existed; stream
+                # the cluster-pair mean co-clustering distances instead (:621)
+                from consensusclustr_tpu.consensus.blockwise import (
+                    cocluster_cluster_distance,
+                )
+                from consensusclustr_tpu.hierarchy.dendro import (
+                    _sorted_unique,
+                    dendrogram_from_cluster_distance,
+                )
 
-            uniq = _sorted_unique(np.asarray(labels))
-            code_of = {u: i for i, u in enumerate(uniq)}
-            codes = np.asarray([code_of[l] for l in labels], np.int32)
-            cmat = cocluster_cluster_distance(
-                cons.boot_labels, codes, cfg.max_clusters,
-                use_pallas=cfg.use_pallas,
-            )
-            dend = dendrogram_from_cluster_distance(cmat, uniq)
-        else:
-            dend = determine_hierarchy(_euclidean(pca_used), labels)
-    elif len(set(labels.tolist())) <= 1:
-        log.event("failed_test")  # the reference's message("Failed Test") :613
+                uniq = _sorted_unique(np.asarray(labels))
+                code_of = {u: i for i, u in enumerate(uniq)}
+                codes = np.asarray([code_of[l] for l in labels], np.int32)
+                cmat = cocluster_cluster_distance(
+                    cons.boot_labels, codes, cfg.max_clusters,
+                    use_pallas=cfg.use_pallas,
+                )
+                dend = dendrogram_from_cluster_distance(cmat, uniq)
+            else:
+                dend = determine_hierarchy(_euclidean(pca_used), labels)
+        elif len(set(labels.tolist())) <= 1:
+            log.event("failed_test")  # the reference's message("Failed Test") :613
 
-    tree = edges = None
-    if cfg.iterate and any("_" in str(l) for l in labels):
-        tree = hierarchy_table(labels)
-        edges = hierarchy_edges(labels)
+        tree = edges = None
+        if cfg.iterate and any("_" in str(l) for l in labels):
+            tree = hierarchy_table(labels)
+            edges = hierarchy_edges(labels)
+
+    # --- run record (obs/): span tree + events + metrics snapshot ---------
+    record_device_memory(tracer.metrics)
+    run_record = RunRecord.from_tracer(
+        tracer, config=cfg, backend=default_backend()
+    )
+    record_path = cfg.run_record_path or os.environ.get("CCTPU_RUN_RECORD")
+    if record_path:
+        try:
+            run_record.write(record_path)
+        except OSError as e:
+            log.event("run_record_write_failed", path=record_path, error=str(e))
 
     return ClusterResult(
         assignments=labels,
@@ -803,4 +857,5 @@ def consensus_clust(
         clustree=tree,
         clustree_edges=edges,
         log=log,
+        run_record=run_record,
     )

@@ -102,6 +102,12 @@ class ClusterConfig:
     compute_dtype: str = "float32"
     use_pallas: bool = True     # Pallas co-clustering kernel on TPU; einsum fallback
     progress: bool = False      # structured per-level logging
+    # Observability sink (obs/): append this run's RunRecord (span tree +
+    # events + metrics, schema-versioned JSON) as one JSONL line to this
+    # path. None still attaches the record to the returned ClusterResult;
+    # the CCTPU_RUN_RECORD env var supplies a default path when unset.
+    # Render with `python tools/report.py <path>`.
+    run_record_path: Optional[str] = None
     # Persist boot chunks; a rerun with identical (data, config, seed)
     # resumes at the first missing chunk. Covers single-chip AND mesh runs,
     # robust AND granular (granular checkpoints the flattened |k|*|res|

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -48,6 +49,7 @@ from consensusclustr_tpu.consensus.merge import (
     merge_small_clusters,
     merge_unstable_clusters,
 )
+from consensusclustr_tpu.obs import maybe_span, metrics_of
 from consensusclustr_tpu.utils.backend import default_backend as _default_backend
 from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
@@ -198,40 +200,51 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
         )
 
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
+    mets = metrics_of(log)
     out_labels, out_scores = [], []
-    for s in range(0, cfg.nboots, chunk):
-        e = min(s + chunk, cfg.nboots)
-        if ckpt is not None:
-            cached = ckpt.load_chunk(s, e - s)
-            if cached is not None:
-                if robust:
-                    out_labels.append(cached[0])
-                    out_scores.append(cached[1])
-                else:  # chunks store the flattened candidate axis
-                    out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
-                    out_scores.append(cached[1].reshape(e - s, rows_per_boot))
-                if log:
-                    log.event("boots_resumed", done=e, total=cfg.nboots)
-                continue
-        # min_size=0: the reference never passes its minSize into the boot
-        # grids (:394-395 vs :650's minSize=0 default) — the 0.15 floor is
-        # inert here and only bites in the null sims (minSize=5).
-        labels, scores = _boot_batch(
-            keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
-            jnp.float32(0.0),
-            len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS, robust, n,
-            cfg.cluster_fun, cfg.compute_dtype,
-        )
-        out_labels.append(np.asarray(labels))
-        out_scores.append(np.asarray(scores))
-        if ckpt is not None:
-            ckpt.save_chunk(
-                s, out_labels[-1].reshape(-1, n), out_scores[-1].reshape(-1)
+    with maybe_span(log, "boots", nboots=cfg.nboots, chunk=chunk):
+        for s in range(0, cfg.nboots, chunk):
+            e = min(s + chunk, cfg.nboots)
+            if ckpt is not None:
+                cached = ckpt.load_chunk(s, e - s)
+                if cached is not None:
+                    if robust:
+                        out_labels.append(cached[0])
+                        out_scores.append(cached[1])
+                    else:  # chunks store the flattened candidate axis
+                        out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
+                        out_scores.append(cached[1].reshape(e - s, rows_per_boot))
+                    mets.counter("boots_resumed").inc(e - s)
+                    if log:
+                        log.event("boots_resumed", done=e, total=cfg.nboots)
+                    continue
+            # min_size=0: the reference never passes its minSize into the boot
+            # grids (:394-395 vs :650's minSize=0 default) — the 0.15 floor is
+            # inert here and only bites in the null sims (minSize=5).
+            t_chunk = time.perf_counter()
+            labels, scores = _boot_batch(
+                keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
+                jnp.float32(0.0),
+                len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS, robust, n,
+                cfg.cluster_fun, cfg.compute_dtype,
             )
-        if log:
-            log.event("boots", done=e, total=cfg.nboots)
-    labels = np.concatenate(out_labels, axis=0)
-    scores = np.concatenate(out_scores, axis=0)
+            out_labels.append(np.asarray(labels))
+            out_scores.append(np.asarray(scores))
+            mets.counter("boots_completed").inc(e - s)
+            mets.counter("leiden_iters").inc(
+                (e - s) * len(k_list) * len(cfg.res_range) * DEFAULT_COMMUNITY_ITERS
+            )
+            mets.histogram("boot_chunk_seconds").observe(
+                time.perf_counter() - t_chunk
+            )
+            if ckpt is not None:
+                ckpt.save_chunk(
+                    s, out_labels[-1].reshape(-1, n), out_scores[-1].reshape(-1)
+                )
+            if log:
+                log.event("boots", done=e, total=cfg.nboots)
+        labels = np.concatenate(out_labels, axis=0)
+        scores = np.concatenate(out_scores, axis=0)
     if not robust:
         labels = labels.reshape(-1, n)                      # [B*K*R, n]
         scores = scores.reshape(-1)
@@ -336,6 +349,7 @@ def _resolve_mesh(cfg: ClusterConfig, n: int, log: Optional[LevelLog] = None):
                     f"n={n} not divisible by cell axis {m.shape[CELL_AXIS]}"
                 )
     if reason is not None:
+        metrics_of(log).counter("mesh_fallbacks").inc()
         if log:
             log.event("mesh_fallback", reason=reason)
         return None
@@ -356,29 +370,31 @@ def _finish_consensus(
 
     dist_np=None is the blockwise regime: the small-cluster merge runs on
     streamed cluster-pair sums instead of the dense matrix."""
-    if dist_np is not None:
-        # small-cluster merge on co-clustering distances (:461-467)
-        labels = merge_small_clusters(
-            dist_np, labels, max(k_list[0], 20), cfg.max_clusters
-        )
-    else:
-        from consensusclustr_tpu.consensus.blockwise import (
-            cocluster_pair_sums,
-            merge_small_clusters_from_sums,
-        )
+    with maybe_span(log, "merge"):
+        if dist_np is not None:
+            # small-cluster merge on co-clustering distances (:461-467)
+            labels = merge_small_clusters(
+                dist_np, labels, max(k_list[0], 20), cfg.max_clusters
+            )
+        else:
+            from consensusclustr_tpu.consensus.blockwise import (
+                cocluster_pair_sums,
+                merge_small_clusters_from_sums,
+            )
 
-        sums, counts = cocluster_pair_sums(
-            jnp.asarray(boot_labels, jnp.int32), jnp.asarray(labels, jnp.int32),
-            cfg.max_clusters, cfg.max_clusters, use_pallas=cfg.use_pallas,
+            sums, counts = cocluster_pair_sums(
+                jnp.asarray(boot_labels, jnp.int32), jnp.asarray(labels, jnp.int32),
+                cfg.max_clusters, cfg.max_clusters, use_pallas=cfg.use_pallas,
+            )
+            labels = merge_small_clusters_from_sums(
+                np.asarray(sums), np.asarray(counts), labels, max(k_list[0], 20)
+            )
+        # stability merge against the per-boot assignments (:469-497)
+        labels = merge_unstable_clusters(
+            labels, boot_labels, cfg.min_stability, cfg.max_clusters
         )
-        labels = merge_small_clusters_from_sums(
-            np.asarray(sums), np.asarray(counts), labels, max(k_list[0], 20)
-        )
-    # stability merge against the per-boot assignments (:469-497)
-    labels = merge_unstable_clusters(
-        labels, boot_labels, cfg.min_stability, cfg.max_clusters
-    )
-    sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
+        sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
+    metrics_of(log).gauge("silhouette_best").set(sil)
     if log:
         log.event(
             "merged", n_clusters=len(np.unique(labels)), silhouette=sil,
@@ -413,9 +429,13 @@ def consensus_cluster(
         dense = cfg.dense_consensus
         if dense is None:
             dense = n <= DENSE_CONSENSUS_LIMIT
-        labels_np, dist_np, boot_labels = distributed_consensus_cluster(
-            key, pca, cfg, mesh, dense=dense, log=log
-        )
+        with maybe_span(
+            log, "consensus_distributed",
+            mesh={k: v for k, v in mesh.shape.items()},
+        ):
+            labels_np, dist_np, boot_labels = distributed_consensus_cluster(
+                key, pca, cfg, mesh, dense=dense, log=log
+            )
         if log:
             log.event(
                 "consensus_distributed",
@@ -429,11 +449,13 @@ def consensus_cluster(
     if cfg.nboots <= 1:
         # no-bootstrap path (reference :498-511); min_size=0 as in the boot
         # path — the reference's :500 call leaves minSize at its 0 default
-        grid = cluster_grid(
-            key, pca, res_list, k_list, jnp.float32(0.0),
-            max_clusters=cfg.max_clusters, cluster_fun=cfg.cluster_fun,
-            compute_dtype=cfg.compute_dtype,
-        )
+        with maybe_span(log, "consensus_grid") as sp:
+            grid = cluster_grid(
+                key, pca, res_list, k_list, jnp.float32(0.0),
+                max_clusters=cfg.max_clusters, cluster_fun=cfg.cluster_fun,
+                compute_dtype=cfg.compute_dtype,
+            )
+            sp.value = grid.labels
         best = int(_ties_last_argmax(grid.scores))
         labels = np.asarray(grid.labels[best])
         # Euclidean small-cluster merge (:504-510): dense matrix below the
@@ -475,28 +497,36 @@ def consensus_cluster(
     if dense is None:
         dense = n <= DENSE_CONSENSUS_LIMIT
     if dense:
-        dist = coclustering_distance(
-            jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
-            use_pallas=cfg.use_pallas,
-        )
-        cons_labels, cons_scores = _consensus_grid(
-            key, dist, pca, res_list, k_list, cfg.max_clusters,
-            cluster_fun=cfg.cluster_fun,
-        )
+        with maybe_span(log, "cocluster", dense=True) as sp:
+            dist = coclustering_distance(
+                jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
+                use_pallas=cfg.use_pallas,
+            )
+            sp.value = dist
+        with maybe_span(log, "consensus_grid") as sp:
+            cons_labels, cons_scores = _consensus_grid(
+                key, dist, pca, res_list, k_list, cfg.max_clusters,
+                cluster_fun=cfg.cluster_fun,
+            )
+            sp.value = (cons_labels, cons_scores)
         dist_np = np.asarray(dist)
     else:
         from consensusclustr_tpu.consensus.blockwise import (
             blockwise_consensus_knn,
         )
 
-        knn_idx, _ = blockwise_consensus_knn(
-            jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters,
-            use_pallas=cfg.use_pallas,
-        )
-        cons_labels, cons_scores = _consensus_grid_from_knn(
-            key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
-            cluster_fun=cfg.cluster_fun,
-        )
+        with maybe_span(log, "cocluster", dense=False) as sp:
+            knn_idx, _ = blockwise_consensus_knn(
+                jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters,
+                use_pallas=cfg.use_pallas,
+            )
+            sp.value = knn_idx
+        with maybe_span(log, "consensus_grid") as sp:
+            cons_labels, cons_scores = _consensus_grid_from_knn(
+                key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
+                cluster_fun=cfg.cluster_fun,
+            )
+            sp.value = (cons_labels, cons_scores)
         dist_np = None
     labels = np.asarray(cons_labels)
     if log:

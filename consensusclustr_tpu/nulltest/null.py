@@ -37,6 +37,7 @@ from consensusclustr_tpu.prep.sizefactors import (
     default_pool_sizes,
     stabilize_size_factors,
 )
+from consensusclustr_tpu.obs import maybe_span, metrics_of
 from consensusclustr_tpu.prep.transform import shifted_log
 from consensusclustr_tpu.utils.rng import sim_key
 
@@ -143,15 +144,21 @@ def generate_null_statistics(
     out = []
     for s in range(0, n_sims, chunk):
         e = min(s + chunk, n_sims)
-        out.append(
-            np.asarray(
+        # per-null-dataset span: at big n each chunk is minutes-to-hours, so
+        # the RunRecord localizes which simulation round ate the wall clock
+        with maybe_span(
+            log, "null_sim_chunk", round_id=round_id, start=s, end=e
+        ) as sp:
+            stats = np.asarray(
                 _null_stat_batch(
                     keys[s:e], model, cov, res_list,
                     int(n_cells), int(pc_num), k_list, pool_sizes,
                     int(max_clusters), has_cov, cluster_fun, compute_dtype,
                 )
             )
-        )
+            sp.value = stats
+        out.append(stats)
+        metrics_of(log).counter("null_sims_completed").inc(e - s)
         if log:
             # hours-scale at big n: observability for long runs
             log.event("null_sims", done=e, total=n_sims, round_id=round_id)

@@ -28,6 +28,7 @@ from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
 from consensusclustr_tpu.linalg.distance import euclidean_distance_matrix as _euclidean
 from consensusclustr_tpu.nulltest.copula import fit_nb_copula
 from consensusclustr_tpu.nulltest.null import generate_null_statistics
+from consensusclustr_tpu.obs import maybe_span
 from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key, root_key
 
@@ -87,47 +88,48 @@ def _clustering_rejected(
     not significant; null_stats is returned so callers can re-test merged
     variants against the SAME null fit, as the reference's failed-split loop
     does (:998 computes new p-values from the existing `fit`)."""
-    n_cells = counts.shape[0]
-    model = fit_nb_copula(cluster_key(key, "copula_fit"), jnp.asarray(counts, jnp.float32))
+    with maybe_span(log, "null_test", n_cells=counts.shape[0]):
+        n_cells = counts.shape[0]
+        model = fit_nb_copula(cluster_key(key, "copula_fit"), jnp.asarray(counts, jnp.float32))
 
-    stats = generate_null_statistics(
-        key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-        covariates=covariates, max_clusters=max_clusters, round_id=0, log=log,
-        cluster_fun=cluster_fun, res_range=res_range,
-        compute_dtype=compute_dtype,
-    )
-    p = null_p_value(silhouette, stats)
-    # Adaptive refinement near the boundary (reference :943-964): +20 sims if
-    # p in [0.05, 0.1), then +20 more if still in [0.05, 0.075).
-    if 0.05 <= p < 0.1:
-        stats = np.concatenate([
-            stats,
-            generate_null_statistics(
-                key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-                covariates=covariates, max_clusters=max_clusters, round_id=1, log=log,
-                cluster_fun=cluster_fun, res_range=res_range,
-                compute_dtype=compute_dtype,
-            ),
-        ])
-        p = null_p_value(silhouette, stats)
-    if 0.05 <= p < 0.075:
-        stats = np.concatenate([
-            stats,
-            generate_null_statistics(
-                key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
-                covariates=covariates, max_clusters=max_clusters, round_id=2, log=log,
-                cluster_fun=cluster_fun, res_range=res_range,
-                compute_dtype=compute_dtype,
-            ),
-        ])
-        p = null_p_value(silhouette, stats)
-    if log:
-        log.event(
-            "null_test", silhouette=silhouette, p_value=p,
-            null_mean=float(np.mean(stats)), null_sd=float(np.std(stats)),
-            n_sims=len(stats),
+        stats = generate_null_statistics(
+            key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+            covariates=covariates, max_clusters=max_clusters, round_id=0, log=log,
+            cluster_fun=cluster_fun, res_range=res_range,
+            compute_dtype=compute_dtype,
         )
-    return p >= alpha, stats
+        p = null_p_value(silhouette, stats)
+        # Adaptive refinement near the boundary (reference :943-964): +20 sims if
+        # p in [0.05, 0.1), then +20 more if still in [0.05, 0.075).
+        if 0.05 <= p < 0.1:
+            stats = np.concatenate([
+                stats,
+                generate_null_statistics(
+                    key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+                    covariates=covariates, max_clusters=max_clusters, round_id=1, log=log,
+                    cluster_fun=cluster_fun, res_range=res_range,
+                    compute_dtype=compute_dtype,
+                ),
+            ])
+            p = null_p_value(silhouette, stats)
+        if 0.05 <= p < 0.075:
+            stats = np.concatenate([
+                stats,
+                generate_null_statistics(
+                    key, model, n_cells, pc_num, n_sims=n_sims, k_num=k_num,
+                    covariates=covariates, max_clusters=max_clusters, round_id=2, log=log,
+                    cluster_fun=cluster_fun, res_range=res_range,
+                    compute_dtype=compute_dtype,
+                ),
+            ])
+            p = null_p_value(silhouette, stats)
+        if log:
+            log.event(
+                "null_test", silhouette=silhouette, p_value=p,
+                null_mean=float(np.mean(stats)), null_sd=float(np.std(stats)),
+                n_sims=len(stats),
+            )
+        return p >= alpha, stats
 
 
 def test_splits(

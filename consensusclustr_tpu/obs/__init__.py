@@ -1,0 +1,61 @@
+"""Run-wide observability: hierarchical spans, metrics, JSONL run records.
+
+Three pieces (ISSUE 1 tentpole), all host-side and import-light:
+
+  * ``Tracer``/``Span`` — parent/child timed regions with the async-dispatch
+    sink contract of ``utils/profiling.phase`` (assign outputs to
+    ``span.value`` and the timer blocks on them) and optional
+    ``jax.profiler.TraceAnnotation`` pass-through;
+  * ``MetricsRegistry`` — counters/gauges/histograms (run-local on the
+    tracer, plus a process-global registry for cross-run state like the
+    persistent compile cache);
+  * ``RunRecord`` — schema-versioned JSONL serialization of span tree +
+    events + metrics + config fingerprint, rendered by ``tools/report.py``.
+
+``utils.log.LevelLog`` is a thin compatibility shim over ``Tracer`` — every
+existing ``log.event(...)`` call site feeds the same record stream.
+``obs/schema.py`` registers all legal event/span/metric names;
+``tools/check_obs_schema.py`` statically enforces the registry.
+"""
+
+from consensusclustr_tpu.obs.metrics import (
+    MetricsRegistry,
+    global_metrics,
+    record_device_memory,
+)
+from consensusclustr_tpu.obs.record import (
+    RunRecord,
+    config_fingerprint,
+    load_records,
+)
+from consensusclustr_tpu.obs.schema import (
+    EVENT_KINDS,
+    METRIC_NAMES,
+    SCHEMA_VERSION,
+    SPAN_NAMES,
+)
+from consensusclustr_tpu.obs.tracer import (
+    Span,
+    Tracer,
+    maybe_span,
+    metrics_of,
+    tracer_of,
+)
+
+__all__ = [
+    "EVENT_KINDS",
+    "METRIC_NAMES",
+    "MetricsRegistry",
+    "RunRecord",
+    "SCHEMA_VERSION",
+    "SPAN_NAMES",
+    "Span",
+    "Tracer",
+    "config_fingerprint",
+    "global_metrics",
+    "load_records",
+    "maybe_span",
+    "metrics_of",
+    "record_device_memory",
+    "tracer_of",
+]

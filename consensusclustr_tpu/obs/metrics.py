@@ -1,0 +1,145 @@
+"""Metrics registry: counters, gauges, histograms.
+
+Host-side (never traced) accounting for the quantities the pipeline already
+knows but previously threw away: bootstraps completed, mesh fallbacks, best
+silhouettes, compile-cache state, device memory. A registry is cheap plain
+Python — safe to update from tight host loops — and snapshots to a flat
+JSON-able dict that lands in the RunRecord.
+
+Two scopes exist: the process-global registry (``global_metrics()``) for
+things that outlive one run (persistent compile cache), and a per-``Tracer``
+registry for run-local counts. ``RunRecord.from_tracer`` merges both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class Counter:
+    """Monotonically increasing count."""
+
+    value: float = 0.0
+
+    def inc(self, by: float = 1.0) -> None:
+        self.value += by
+
+
+@dataclasses.dataclass
+class Gauge:
+    """Last-written value (set() wins; unset gauges serialize as None)."""
+
+    value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+@dataclasses.dataclass
+class Histogram:
+    """Streaming summary (count/sum/min/max) — no buckets, no raw samples,
+    so hot loops can observe() without growing memory."""
+
+    count: int = 0
+    sum: float = 0.0
+    min: Optional[float] = None
+    max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+
+class MetricsRegistry:
+    """Named counters/gauges/histograms with lazy creation and merge."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self.gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        return self.histograms.setdefault(name, Histogram())
+
+    def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
+        """Fold ``other`` into self: counters add, later gauges win (when
+        set), histogram summaries combine. Returns self for chaining."""
+        for name, c in other.counters.items():
+            self.counter(name).inc(c.value)
+        for name, g in other.gauges.items():
+            if g.value is not None:
+                self.gauge(name).set(g.value)
+        for name, h in other.histograms.items():
+            mine = self.histogram(name)
+            mine.count += h.count
+            mine.sum += h.sum
+            for bound in ("min", "max"):
+                theirs = getattr(h, bound)
+                if theirs is None:
+                    continue
+                ours = getattr(mine, bound)
+                pick = theirs if ours is None else (
+                    min(ours, theirs) if bound == "min" else max(ours, theirs)
+                )
+                setattr(mine, bound, pick)
+        return self
+
+    def snapshot(self) -> dict:
+        """Flat JSON-able view; empty sections are dropped."""
+        out: dict = {}
+        if self.counters:
+            out["counters"] = {k: c.value for k, c in sorted(self.counters.items())}
+        if self.gauges:
+            out["gauges"] = {k: g.value for k, g in sorted(self.gauges.items())}
+        if self.histograms:
+            out["histograms"] = {
+                k: {
+                    "count": h.count, "sum": round(h.sum, 6),
+                    "min": h.min, "max": h.max, "mean": h.mean,
+                }
+                for k, h in sorted(self.histograms.items())
+            }
+        return out
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_metrics() -> MetricsRegistry:
+    """Process-wide registry (compile cache and other cross-run state)."""
+    return _GLOBAL
+
+
+def record_device_memory(registry: MetricsRegistry) -> None:
+    """Gauge the first local device's live memory when the backend reports it
+    (TPU/GPU do; XLA:CPU returns None) — never raises, never initializes a
+    backend that the process hasn't already touched."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return
+    if not stats:
+        return
+    if "bytes_in_use" in stats:
+        registry.gauge("device_bytes_in_use").set(int(stats["bytes_in_use"]))
+    if "peak_bytes_in_use" in stats:
+        registry.gauge("device_peak_bytes_in_use").set(
+            int(stats["peak_bytes_in_use"])
+        )

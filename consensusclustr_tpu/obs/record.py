@@ -1,0 +1,158 @@
+"""RunRecord: the serialized unit of observability.
+
+One record = one top-level run (a ``consensus_clust`` call, a bench config, a
+null-test campaign): schema version, config fingerprint, backend, the span
+tree, the flat event stream, and a metrics snapshot. Serialized as one JSON
+object per line (JSONL) so long-lived processes append records and
+``tools/report.py`` renders any of them later.
+
+Kept deliberately jax-free at import time: report tooling and post-hoc
+analysis load records without touching a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, List, Optional
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+from consensusclustr_tpu.obs.tracer import Span, Tracer
+
+
+def _jsonable(x: Any):
+    """json.dumps default: numpy scalars/arrays -> python, else str."""
+    try:
+        import numpy as np
+
+        if isinstance(x, (np.integer,)):
+            return int(x)
+        if isinstance(x, (np.floating,)):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+    except Exception:
+        pass
+    return str(x)
+
+
+def config_fingerprint(cfg: Any) -> Optional[str]:
+    """Short stable hash of a config's field values (dataclass, dict, or any
+    attr-bearing object); arrays and exotic values hash via their str form."""
+    if cfg is None:
+        return None
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        d = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}
+    elif isinstance(cfg, dict):
+        d = cfg
+    else:
+        d = dict(vars(cfg))
+    blob = json.dumps(d, sort_keys=True, default=_jsonable)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def _config_dict(cfg: Any) -> Optional[dict]:
+    if cfg is None:
+        return None
+    if dataclasses.is_dataclass(cfg) and not isinstance(cfg, type):
+        d = {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}
+    elif isinstance(cfg, dict):
+        d = cfg
+    else:
+        d = dict(vars(cfg))
+    # round-trip through JSON so the record is self-contained plain data
+    return json.loads(json.dumps(d, default=_jsonable))
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """Schema-versioned snapshot of one run's observability state."""
+
+    schema: int = SCHEMA_VERSION
+    backend: Optional[str] = None
+    config_fingerprint: Optional[str] = None
+    wall_s: Optional[float] = None
+    spans: List[Span] = dataclasses.field(default_factory=list)
+    events: List[dict] = dataclasses.field(default_factory=list)
+    metrics: dict = dataclasses.field(default_factory=dict)
+    config: Optional[dict] = None
+
+    @classmethod
+    def from_tracer(
+        cls,
+        tracer: Tracer,
+        config: Any = None,
+        backend: Optional[str] = None,
+        include_global_metrics: bool = True,
+    ) -> "RunRecord":
+        reg = MetricsRegistry()
+        if include_global_metrics:
+            from consensusclustr_tpu.obs.metrics import global_metrics
+
+            reg.merge(global_metrics())
+        reg.merge(tracer.metrics)
+        return cls(
+            schema=SCHEMA_VERSION,
+            backend=backend,
+            config_fingerprint=config_fingerprint(config),
+            wall_s=tracer.elapsed(),
+            spans=list(tracer.roots),
+            events=list(tracer.events),
+            metrics=reg.snapshot(),
+            config=_config_dict(config),
+        )
+
+    def phase_seconds(self) -> Dict[str, float]:
+        """Top-level phase breakdown (root-span seconds summed by name)."""
+        out: Dict[str, float] = {}
+        for sp in self.spans:
+            if sp.seconds is not None:
+                out[sp.name] = round(out.get(sp.name, 0.0) + sp.seconds, 4)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": self.schema,
+            "backend": self.backend,
+            "config_fingerprint": self.config_fingerprint,
+            "wall_s": self.wall_s,
+            "phases": self.phase_seconds(),
+            "spans": [s.to_dict() for s in self.spans],
+            "events": self.events,
+            "metrics": self.metrics,
+            "config": self.config,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), default=_jsonable)
+
+    def write(self, path: str) -> None:
+        """Append this record as one JSONL line."""
+        with open(path, "a") as f:
+            f.write(self.to_json() + "\n")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunRecord":
+        return cls(
+            schema=int(d.get("schema", 0)),
+            backend=d.get("backend"),
+            config_fingerprint=d.get("config_fingerprint"),
+            wall_s=d.get("wall_s"),
+            spans=[Span.from_dict(s) for s in d.get("spans", [])],
+            events=list(d.get("events", [])),
+            metrics=dict(d.get("metrics", {})),
+            config=d.get("config"),
+        )
+
+
+def load_records(path: str) -> List[RunRecord]:
+    """All RunRecords in a JSONL (or single-object JSON) file."""
+    out: List[RunRecord] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(RunRecord.from_dict(json.loads(line)))
+    return out

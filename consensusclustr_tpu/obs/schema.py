@@ -1,0 +1,91 @@
+"""Observability schema registry (the contract tools/check_obs_schema.py
+enforces).
+
+Every event kind, span name and metric name used anywhere in the package (and
+bench.py) must be registered here. The static check scans the sources for
+literal ``.event("...")`` / ``.span("...")`` / ``.counter("...")`` calls and
+fails the tier-1 suite on any name missing from these sets — a typo'd metric
+name is a test failure, not a silently empty dashboard.
+
+SCHEMA_VERSION stamps every RunRecord and bench JSON line (``obs_schema``) so
+BENCH_*.json trajectories across PRs stay machine-comparable: a consumer can
+refuse to diff phase breakdowns produced under different schemas. Bump it when
+a registered name changes meaning, is removed, or the RunRecord layout
+changes shape.
+"""
+
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+# ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
+# stream (the original LevelLog contract, SURVEY §5).
+EVENT_KINDS = frozenset({
+    # api.py level driver
+    "level_start",
+    "too_small",
+    "prep",
+    "regressed",
+    "interactive_pc_num",
+    "pca",
+    "pca_failed",
+    "null_test_skipped",
+    "level_done",
+    "subcluster_failed",
+    "failed_test",
+    "run_record_write_failed",
+    # consensus/pipeline.py + parallel/step.py
+    "boots",
+    "boots_resumed",
+    "mesh_fallback",
+    "mesh_auto_boot_only",
+    "consensus",
+    "consensus_distributed",
+    "no_boot_result",
+    "merged",
+    # nulltest/
+    "null_sims",
+    "null_test",
+    "split_retest",
+    # utils/profiling.py
+    "phase",
+})
+
+# Hierarchical span names (``Tracer.span`` / ``maybe_span``).
+SPAN_NAMES = frozenset({
+    # api.py run phases (top level of a consensus_clust RunRecord)
+    "ingest",
+    "level",
+    "iterate",
+    "assemble",
+    # api.py within-level phases
+    "prep",
+    "regress",
+    "pca",
+    "consensus",
+    "significance",
+    # consensus/pipeline.py
+    "boots",
+    "cocluster",
+    "consensus_grid",
+    "merge",
+    "consensus_distributed",
+    # nulltest/
+    "null_test",
+    "null_sim_chunk",
+})
+
+# Metrics registry names (counters, gauges, histograms).
+METRIC_NAMES = frozenset({
+    "boots_completed",          # counter: bootstraps actually computed (not resumed)
+    "boots_resumed",            # counter: bootstraps loaded from checkpoint
+    "leiden_iters",             # counter: community-detection local-move iterations dispatched
+    "null_sims_completed",      # counter: null-model simulations finished
+    "mesh_fallbacks",           # counter: sharded levels that fell back to single-chip
+    "silhouette_best",          # gauge: last consensus silhouette
+    "compile_cache_enabled",    # gauge: 1 when the persistent XLA cache is active
+    "compile_cache_entries",    # gauge: cache-dir entries at enable time (warm-cache proxy)
+    "device_bytes_in_use",      # gauge: jax device memory_stats() at record time
+    "device_peak_bytes_in_use", # gauge: peak device memory, when the backend reports it
+    "boot_chunk_seconds",       # histogram: wall seconds per computed boot chunk
+})

@@ -1,0 +1,209 @@
+"""Hierarchical spans + flat events: the run-wide tracer.
+
+Generalizes ``utils/profiling.phase`` (wall-clock with block-until-ready
+semantics) into a parent/child span tree, so a run record can answer "where
+did the time go" per phase AND per nesting level (a null test inside the
+significance gate inside level 2). JAX dispatch is async: assign a span's
+output arrays to ``span.value`` and the timer blocks on them at exit, the
+same sink contract ``phase`` established.
+
+Spans are host-side and cheap (one dataclass + two clock reads); the optional
+``annotate=True`` additionally enters a ``jax.profiler.TraceAnnotation`` so
+the same name shows up inside device traces (TensorBoard / Perfetto).
+
+``Tracer.event`` carries the original flat LevelLog record stream; events
+emitted inside a span are stamped with the span path so the two views join.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry, global_metrics
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed region; ``children`` nest, ``value`` is the async-dispatch
+    sink (blocked on at exit, never serialized)."""
+
+    name: str
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    t0: float = 0.0                  # start, seconds since tracer epoch
+    seconds: Optional[float] = None  # None while the span is open
+    ok: bool = True
+    error: Optional[str] = None
+    children: List["Span"] = dataclasses.field(default_factory=list)
+    value: Any = None
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def to_dict(self) -> dict:
+        d: dict = {"name": self.name, "t0": self.t0, "seconds": self.seconds}
+        if not self.ok:
+            d["ok"] = False
+            d["error"] = self.error
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(
+            name=d.get("name", "?"),
+            attrs=dict(d.get("attrs", {})),
+            t0=float(d.get("t0", 0.0)),
+            seconds=d.get("seconds"),
+            ok=bool(d.get("ok", True)),
+            error=d.get("error"),
+            children=[cls.from_dict(c) for c in d.get("children", [])],
+        )
+
+    def walk(self, depth: int = 0):
+        yield depth, self
+        for c in self.children:
+            yield from c.walk(depth + 1)
+
+
+class Tracer:
+    """Collects a span tree, a flat event list, and a metrics registry for
+    one run. Not thread-safe by design: the pipeline's host control is a
+    single thread (SURVEY §7.1), and a lock in the hot path would cost more
+    than it protects."""
+
+    def __init__(
+        self,
+        progress: bool = False,
+        annotate: bool = False,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.progress = progress
+        self.annotate = annotate
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.roots: List[Span] = []
+        self.events: List[dict] = []
+        self.epoch = time.monotonic()
+        self._stack: List[Span] = []
+
+    # -- spans ---------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(
+        self, name: str, annotate: Optional[bool] = None, **attrs: Any
+    ) -> Iterator[Span]:
+        sp = Span(
+            name=name, attrs=dict(attrs),
+            t0=round(time.monotonic() - self.epoch, 4),
+        )
+        (self._stack[-1].children if self._stack else self.roots).append(sp)
+        self._stack.append(sp)
+        ann = None
+        if self.annotate if annotate is None else annotate:
+            try:
+                import jax
+
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
+        t0 = time.perf_counter()
+        try:
+            yield sp
+        except BaseException as e:
+            sp.ok = False
+            sp.error = type(e).__name__
+            raise
+        finally:
+            if sp.ok and sp.value is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(sp.value)
+                except Exception:
+                    pass
+            sp.value = None
+            sp.seconds = round(time.perf_counter() - t0, 4)
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:
+                    pass
+            self._stack.pop()
+            if self.progress:
+                self._emit({
+                    "t": sp.t0, "kind": "span", "name": self.span_path(sp.name),
+                    "seconds": sp.seconds,
+                    **({} if sp.ok else {"ok": False, "error": sp.error}),
+                })
+
+    def span_path(self, leaf: Optional[str] = None) -> str:
+        parts = [s.name for s in self._stack]
+        if leaf is not None and (not parts or parts[-1] != leaf):
+            parts.append(leaf)
+        return "/".join(parts)
+
+    # -- flat events (LevelLog contract) -------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> None:
+        rec = {"t": round(time.monotonic() - self.epoch, 4), "kind": kind, **fields}
+        if self._stack:
+            rec.setdefault("span", self.span_path())
+        self.events.append(rec)
+        if self.progress:
+            self._emit(rec)
+
+    # -- aggregation ---------------------------------------------------------
+
+    def phase_seconds(self) -> Dict[str, float]:
+        """Top-level phase breakdown: root-span seconds summed by name."""
+        out: Dict[str, float] = {}
+        for sp in self.roots:
+            if sp.seconds is not None:
+                out[sp.name] = round(out.get(sp.name, 0.0) + sp.seconds, 4)
+        return out
+
+    def elapsed(self) -> float:
+        return round(time.monotonic() - self.epoch, 4)
+
+    @staticmethod
+    def _emit(rec: dict) -> None:
+        import json
+
+        from consensusclustr_tpu.utils.log import _jsonable, get_logger
+
+        get_logger().info(json.dumps(rec, default=_jsonable))
+
+
+@contextlib.contextmanager
+def _null_span(name: str, **attrs: Any) -> Iterator[Span]:
+    # detached Span: callers can .set()/.value without a tracer in scope
+    yield Span(name=name, attrs=dict(attrs))
+
+
+def tracer_of(log: Any) -> Optional[Tracer]:
+    """The Tracer behind a LevelLog shim (or a bare Tracer); None otherwise."""
+    if isinstance(log, Tracer):
+        return log
+    tr = getattr(log, "tracer", None)
+    return tr if isinstance(tr, Tracer) else None
+
+
+def maybe_span(log: Any, name: str, **attrs: Any):
+    """Span on the log's tracer, or an inert detached span when ``log`` is
+    None / tracer-less — lets library code instrument unconditionally."""
+    tr = tracer_of(log)
+    if tr is None:
+        return _null_span(name, **attrs)
+    return tr.span(name, **attrs)
+
+
+def metrics_of(log: Any) -> MetricsRegistry:
+    """The log's run-local registry, or the process-global one."""
+    tr = tracer_of(log)
+    return tr.metrics if tr is not None else global_metrics()

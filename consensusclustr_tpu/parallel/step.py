@@ -47,6 +47,7 @@ from consensusclustr_tpu.parallel.cocluster import (
     sharded_blockwise_consensus_knn,
     sharded_coclustering_distance,
 )
+from consensusclustr_tpu.obs import metrics_of
 from consensusclustr_tpu.parallel.knn import sharded_knn_from_distance
 from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
 from consensusclustr_tpu.utils.rng import cluster_key
@@ -283,6 +284,7 @@ def distributed_consensus_cluster(
         cluster_fun=cfg.cluster_fun, compute_dtype=cfg.compute_dtype,
         dense=dense, granular=granular,
     )
+    metrics_of(log).counter("boots_completed").inc(cfg.nboots)
     return (
         np.asarray(out.labels),
         np.asarray(out.dist) if (return_dist and out.dist is not None) else None,
@@ -366,6 +368,7 @@ def _checkpointed_distributed_run(
         cached = ckpt.load_chunk(s, e - s)
         if cached is not None:
             chunks.append(cached[0])
+            metrics_of(log).counter("boots_resumed").inc(e - s)
             if log:
                 log.event("boots_resumed", done=e, total=b_pad, distributed=True)
             continue
@@ -385,6 +388,7 @@ def _checkpointed_distributed_run(
             lab_np = np.asarray(lab)
         ckpt.save_chunk(s, lab_np, np.asarray(sc).reshape(-1))
         chunks.append(lab_np)
+        metrics_of(log).counter("boots_completed").inc(e - s)
         if log:
             log.event("boots", done=e, total=b_pad, distributed=True)
 

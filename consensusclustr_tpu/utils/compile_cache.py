@@ -15,6 +15,7 @@ import os
 
 import jax
 
+from consensusclustr_tpu.obs import global_metrics
 from consensusclustr_tpu.utils.backend import default_backend
 
 _done = False
@@ -30,6 +31,7 @@ def enable_persistent_cache() -> None:
     # warnings from the AOT loader). CPU compiles are cheap anyway — the
     # cache only pays for itself on accelerators, so enable it only there.
     if default_backend() == "cpu":
+        global_metrics().gauge("compile_cache_enabled").set(0)
         _done = True
         return
     cache_dir = os.environ.get(
@@ -41,6 +43,16 @@ def enable_persistent_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache even fast compiles: recursion levels re-enter many small jits
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # RunRecord accounting: enabled flag + entry count at enable time (a
+        # warm-cache proxy — jax exposes no per-lookup hit counter); a later
+        # run with entries > 0 started warm.
+        global_metrics().gauge("compile_cache_enabled").set(1)
+        try:
+            global_metrics().gauge("compile_cache_entries").set(
+                len(os.listdir(cache_dir))
+            )
+        except OSError:
+            pass
     except Exception:
         pass  # cache is an optimisation, never a requirement
     _done = True

@@ -1,48 +1,97 @@
-"""Structured per-level observability.
+"""Structured per-level observability (compatibility layer).
 
 The reference's only observability is one message("Failed Test")
-(reference R/consensusClust.R:613). The build plan (SURVEY §5) calls for a
-structured per-level log: cells, pcNum, candidate scores, best silhouette,
-p-values, merges. ``LevelLog`` collects those records; ``get_logger`` is plain
-stdlib logging so the package never prints unless asked.
+(reference R/consensusClust.R:613). The build plan (SURVEY §5) called for a
+structured per-level log; that grew into the full ``obs/`` subsystem
+(hierarchical spans + metrics + RunRecords). ``LevelLog`` remains the
+interface every call site already uses, now as a thin shim over
+``obs.Tracer``: ``event(...)`` feeds the tracer's flat record stream and
+``records`` aliases it, so pre-obs code and tests keep working unchanged.
+
+``get_logger`` is plain stdlib logging so the package never prints unless
+asked; ``CCTPU_LOG_LEVEL`` (name like "DEBUG" or a number) overrides the
+level.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import json
 import logging
-import time
-from typing import Any, Dict, List, Optional
+import os
+from typing import Any, List, Optional
+
+from consensusclustr_tpu.obs.tracer import Tracer
+
+_HANDLER_MARK = "_cctpu_handler"
 
 
 def get_logger(name: str = "consensusclustr_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
-    if not logger.handlers:
+    # Marker-based dedup: `logging.getLogger` returns the same object across
+    # repeated import/reload, but checking `logger.handlers` truthiness would
+    # still double-add ours next to any handler another library attached.
+    if not any(getattr(h, _HANDLER_MARK, False) for h in logger.handlers):
         handler = logging.StreamHandler()
         handler.setFormatter(logging.Formatter("[%(name)s] %(message)s"))
+        setattr(handler, _HANDLER_MARK, True)
         logger.addHandler(handler)
-        logger.setLevel(logging.INFO)
         logger.propagate = False
+    env_level = os.environ.get("CCTPU_LOG_LEVEL", "").strip()
+    if env_level:
+        try:
+            logger.setLevel(
+                int(env_level) if env_level.isdigit() else env_level.upper()
+            )
+        except ValueError:
+            logger.setLevel(logging.INFO)
+    elif logger.level == logging.NOTSET:
+        logger.setLevel(logging.INFO)
     return logger
 
 
-@dataclasses.dataclass
 class LevelLog:
-    """Append-only record of what happened at one recursion level."""
+    """Append-only record of what happened at one recursion level.
 
-    records: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
-    enabled: bool = False
-    _t0: float = dataclasses.field(default_factory=time.monotonic)
+    Thin compatibility shim over ``obs.Tracer``: the constructor signature
+    (``records``, ``enabled``, ``_t0``) matches the original dataclass, and
+    ``records`` is the live tracer event list. Pass ``tracer=`` to wrap an
+    existing tracer (bench.py does); ``child()`` shares the tracer so
+    recursion levels append to one stream, as before.
+    """
+
+    def __init__(
+        self,
+        records: Optional[List[dict]] = None,
+        enabled: bool = False,
+        _t0: Optional[float] = None,
+        tracer: Optional[Tracer] = None,
+    ) -> None:
+        if tracer is None:
+            tracer = Tracer(progress=enabled)
+            if records is not None:
+                tracer.events = records
+            if _t0 is not None:
+                tracer.epoch = _t0
+        elif enabled:
+            tracer.progress = True
+        self.tracer = tracer
+        self.enabled = enabled or tracer.progress
+
+    @property
+    def records(self) -> List[dict]:
+        return self.tracer.events
+
+    @property
+    def _t0(self) -> float:
+        return self.tracer.epoch
 
     def event(self, kind: str, **fields: Any) -> None:
-        rec = {"t": round(time.monotonic() - self._t0, 4), "kind": kind, **fields}
-        self.records.append(rec)
-        if self.enabled:
-            get_logger().info(json.dumps(rec, default=_jsonable))
+        self.tracer.event(kind, **fields)
+
+    def span(self, name: str, **attrs: Any):
+        return self.tracer.span(name, **attrs)
 
     def child(self) -> "LevelLog":
-        return LevelLog(records=self.records, enabled=self.enabled, _t0=self._t0)
+        return LevelLog(enabled=self.enabled, tracer=self.tracer)
 
 
 def _jsonable(x: Any):
@@ -58,3 +107,6 @@ def _jsonable(x: Any):
     except Exception:
         pass
     return str(x)
+
+
+__all__ = ["LevelLog", "get_logger", "_jsonable"]

@@ -4,6 +4,10 @@ The reference has no timers at all; the tracked metric here is bootstraps/sec
 (BASELINE.md), so the two tools that matter are wall-clock phase timers that
 land in the structured LevelLog and jax.profiler traces for kernel-level work
 (viewable in TensorBoard / Perfetto).
+
+``phase`` predates the ``obs`` span tracer and remains the flat-event timer;
+new code should prefer ``obs.Tracer.span`` / ``obs.maybe_span`` (hierarchy,
+RunRecords). Both share the block-until-ready sink contract.
 """
 
 from __future__ import annotations
@@ -35,16 +39,33 @@ def phase(name: str, log: Optional[LevelLog] = None, **fields) -> Iterator[Phase
             p.value = jitted_fn(x)
 
     Without a sink value, only host work inside the block is covered.
+
+    Exception paths stay distinguishable from success: the emitted event
+    carries ``ok: False`` and the exception type, then the exception
+    re-raises. (A failed phase's timing covers dispatch up to the raise; the
+    sink is not blocked on, its value may be poisoned.)
     """
     sink = PhaseSink()
     t0 = time.perf_counter()
+    err: Optional[BaseException] = None
     try:
         yield sink
+    except BaseException as e:
+        err = e
+        raise
     finally:
-        if sink.value is not None:
+        if err is None and sink.value is not None:
             jax.block_until_ready(sink.value)
         if log is not None:
-            log.event("phase", name=name, seconds=round(time.perf_counter() - t0, 4), **fields)
+            status = (
+                {"ok": True}
+                if err is None
+                else {"ok": False, "error": type(err).__name__}
+            )
+            log.event(
+                "phase", name=name,
+                seconds=round(time.perf_counter() - t0, 4), **fields, **status,
+            )
 
 
 @contextlib.contextmanager

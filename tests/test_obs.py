@@ -1,0 +1,367 @@
+"""Observability subsystem (obs/): spans, metrics, RunRecords, shims, schema.
+
+Covers the ISSUE 1 checklist: span nesting/ordering, metrics registry merge,
+RunRecord round-trip (write -> tools/report.py parse), the LevelLog
+compatibility shim, get_logger env/handler behavior, phase() failure tagging,
+and the static schema check over the real package sources.
+"""
+
+import importlib
+import importlib.util
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.obs import (
+    MetricsRegistry,
+    RunRecord,
+    SCHEMA_VERSION,
+    Span,
+    Tracer,
+    config_fingerprint,
+    load_records,
+    maybe_span,
+    metrics_of,
+    tracer_of,
+)
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.utils.log import LevelLog, get_logger
+from consensusclustr_tpu.utils.profiling import phase
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSpans:
+    def test_nesting_and_ordering(self):
+        tr = Tracer()
+        with tr.span("a"):
+            with tr.span("b", k=1):
+                pass
+            with tr.span("c"):
+                pass
+        with tr.span("d"):
+            pass
+        assert [s.name for s in tr.roots] == ["a", "d"]
+        assert [s.name for s in tr.roots[0].children] == ["b", "c"]
+        assert tr.roots[0].children[0].attrs == {"k": 1}
+        for _, sp in tr.roots[0].walk():
+            assert sp.seconds is not None and sp.seconds >= 0
+        # siblings are ordered by start time
+        b, c = tr.roots[0].children
+        assert b.t0 <= c.t0
+
+    def test_exception_tags_span_and_unwinds(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    raise ValueError("boom")
+        outer, = tr.roots
+        assert not outer.ok and outer.error == "ValueError"
+        assert not outer.children[0].ok
+        assert outer.seconds is not None  # closed despite the raise
+        assert tr._stack == []  # fully unwound
+        with tr.span("after"):
+            pass
+        assert [s.name for s in tr.roots] == ["outer", "after"]  # a new root
+
+    def test_sink_blocks_on_value(self):
+        import jax.numpy as jnp
+
+        tr = Tracer()
+        with tr.span("compute") as sp:
+            sp.value = jnp.arange(8) * 2
+        assert tr.roots[0].seconds is not None
+        assert tr.roots[0].value is None  # sink cleared, never serialized
+
+    def test_event_inside_span_gets_path(self):
+        tr = Tracer()
+        with tr.span("a"):
+            with tr.span("b"):
+                tr.event("boots", done=1)
+        assert tr.events[0]["span"] == "a/b"
+        tr.event("boots", done=2)
+        assert "span" not in tr.events[1]
+
+    def test_maybe_span_without_tracer_is_inert(self):
+        with maybe_span(None, "prep", n=3) as sp:
+            sp.value = 1
+            sp.set(extra=True)
+        assert isinstance(sp, Span)
+        log = LevelLog()
+        with maybe_span(log, "prep"):
+            pass
+        assert log.tracer.roots[0].name == "prep"
+
+    def test_phase_seconds_aggregates_roots_by_name(self):
+        tr = Tracer()
+        for _ in range(3):
+            with tr.span("boots"):
+                pass
+        with tr.span("cocluster"):
+            pass
+        ps = tr.phase_seconds()
+        assert set(ps) == {"boots", "cocluster"}
+        assert ps["boots"] >= 0
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        reg.counter("boots_completed").inc()
+        reg.counter("boots_completed").inc(4)
+        reg.gauge("silhouette_best").set(0.5)
+        for v in (1.0, 3.0, 2.0):
+            reg.histogram("boot_chunk_seconds").observe(v)
+        snap = reg.snapshot()
+        assert snap["counters"]["boots_completed"] == 5
+        assert snap["gauges"]["silhouette_best"] == 0.5
+        h = snap["histograms"]["boot_chunk_seconds"]
+        assert h["count"] == 3 and h["min"] == 1.0 and h["max"] == 3.0
+        assert h["mean"] == pytest.approx(2.0)
+        json.dumps(snap)  # snapshot must be plain JSON
+
+    def test_merge(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("x").inc(2)
+        b.counter("x").inc(3)
+        b.counter("y").inc()
+        a.gauge("g").set(1.0)
+        b.gauge("g").set(2.0)
+        a.histogram("h").observe(1.0)
+        b.histogram("h").observe(5.0)
+        a.merge(b)
+        snap = a.snapshot()
+        assert snap["counters"] == {"x": 5, "y": 1}
+        assert snap["gauges"]["g"] == 2.0  # later registry wins
+        assert snap["histograms"]["h"]["count"] == 2
+        assert snap["histograms"]["h"]["min"] == 1.0
+        assert snap["histograms"]["h"]["max"] == 5.0
+
+    def test_merge_does_not_overwrite_with_unset_gauge(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.gauge("g").set(1.0)
+        b.gauge("g")  # created but never set
+        a.merge(b)
+        assert a.snapshot()["gauges"]["g"] == 1.0
+
+    def test_metrics_of_falls_back_to_global(self):
+        from consensusclustr_tpu.obs import global_metrics
+
+        assert metrics_of(None) is global_metrics()
+        tr = Tracer()
+        assert metrics_of(tr) is tr.metrics
+        assert metrics_of(LevelLog(tracer=tr)) is tr.metrics
+
+
+class TestRunRecord:
+    def _tracer(self):
+        tr = Tracer()
+        with tr.span("boots", nboots=2) as sp:
+            with tr.span("cocluster"):
+                tr.event("boots", done=2, total=2)
+            sp.set(done=True)
+        tr.metrics.counter("boots_completed").inc(2)
+        return tr
+
+    def test_roundtrip_dict(self):
+        tr = self._tracer()
+        rec = RunRecord.from_tracer(
+            tr, config={"nboots": 2}, backend="cpu",
+            include_global_metrics=False,
+        )
+        back = RunRecord.from_dict(json.loads(rec.to_json()))
+        assert back.schema == SCHEMA_VERSION
+        assert back.backend == "cpu"
+        assert back.phase_seconds() == rec.phase_seconds()
+        assert back.spans[0].children[0].name == "cocluster"
+        assert back.events == rec.events
+        assert back.metrics["counters"]["boots_completed"] == 2
+        assert back.config == {"nboots": 2}
+
+    def test_jsonl_append_and_load(self, tmp_path):
+        path = str(tmp_path / "rr.jsonl")
+        for _ in range(2):
+            RunRecord.from_tracer(
+                self._tracer(), include_global_metrics=False
+            ).write(path)
+        recs = load_records(path)
+        assert len(recs) == 2
+        assert all(r.schema == SCHEMA_VERSION for r in recs)
+
+    def test_report_cli_renders_table(self, tmp_path):
+        path = str(tmp_path / "rr.jsonl")
+        RunRecord.from_tracer(
+            self._tracer(), backend="cpu", include_global_metrics=False
+        ).write(path)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "report.py"), path],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "per-phase" in out and "boots" in out
+        assert "cocluster" in out  # nested span rendered in the flame view
+        assert "boots_completed" in out
+
+    def test_report_module_parses_record(self, tmp_path):
+        report = _load_tool("report")
+        path = str(tmp_path / "rr.jsonl")
+        RunRecord.from_tracer(
+            self._tracer(), include_global_metrics=False
+        ).write(path)
+        rec = report.load(path)[-1]
+        table = report.phase_table(rec)
+        assert "boots" in table and "seconds" in table
+        assert "cocluster" in report.flame(rec)
+
+    def test_config_fingerprint_stability(self):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        a = config_fingerprint(ClusterConfig())
+        assert a == config_fingerprint(ClusterConfig())
+        assert a != config_fingerprint(ClusterConfig(nboots=7))
+        assert config_fingerprint(None) is None
+
+
+class TestLevelLogShim:
+    def test_event_appends_records(self):
+        log = LevelLog()
+        log.event("boots", done=1)
+        assert log.records[-1]["kind"] == "boots"
+        assert log.records[-1]["t"] >= 0
+
+    def test_child_shares_stream(self):
+        log = LevelLog()
+        log.child().event("prep", n_genes_kept=5)
+        assert log.records[-1]["kind"] == "prep"
+        assert tracer_of(log.child()) is log.tracer
+
+    def test_wraps_existing_tracer(self):
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        log.event("boots", done=1)
+        assert tr.events is log.records
+        with log.span("prep"):
+            pass
+        assert tr.roots[0].name == "prep"
+
+    def test_constructor_back_compat(self):
+        shared = []
+        log = LevelLog(records=shared, enabled=False, _t0=0.0)
+        log.event("boots", done=1)
+        assert shared and shared[0]["kind"] == "boots"
+
+
+class TestGetLogger:
+    def test_no_duplicate_handlers(self):
+        a = get_logger("cctpu_test_dedup")
+        n = len(a.handlers)
+        b = get_logger("cctpu_test_dedup")
+        assert b is a and len(b.handlers) == n == 1
+
+    def test_survives_module_reload(self):
+        import consensusclustr_tpu.utils.log as logmod
+
+        get_logger("cctpu_test_reload")
+        importlib.reload(logmod)
+        logger = logmod.get_logger("cctpu_test_reload")
+        assert len(logger.handlers) == 1
+
+    def test_env_level(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_LOG_LEVEL", "DEBUG")
+        assert get_logger("cctpu_test_lvl").level == logging.DEBUG
+        monkeypatch.setenv("CCTPU_LOG_LEVEL", "40")
+        assert get_logger("cctpu_test_lvl").level == logging.ERROR
+        monkeypatch.setenv("CCTPU_LOG_LEVEL", "not_a_level")
+        assert get_logger("cctpu_test_lvl").level == logging.INFO
+
+
+class TestPhaseFailure:
+    def test_failure_tagged_and_reraised(self):
+        log = LevelLog()
+        with pytest.raises(RuntimeError):
+            with phase("boots", log, n=1):
+                raise RuntimeError("dead")
+        rec = log.records[-1]
+        assert rec["kind"] == "phase" and rec["name"] == "boots"
+        assert rec["ok"] is False and rec["error"] == "RuntimeError"
+        assert rec["seconds"] >= 0
+
+    def test_success_tagged_ok(self):
+        log = LevelLog()
+        with phase("boots", log) as p:
+            p.value = np.zeros(2)
+        assert log.records[-1]["ok"] is True
+        assert "error" not in log.records[-1]
+
+
+class TestSchemaCheck:
+    def test_package_sources_clean(self):
+        check_mod = _load_tool("check_obs_schema")
+        assert check_mod.check(REPO_ROOT) == []
+
+    def test_catches_unregistered_names(self, tmp_path):
+        check_mod = _load_tool("check_obs_schema")
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            'log.event("tpyo_event")\n'
+            'tr.span("tpyo_span")\n'
+            'maybe_span(log, "tpyo_span2")\n'
+            'm.counter("tpyo_metric")\n'
+        )
+        errors = check_mod.check(str(tmp_path))
+        assert len(errors) == 4
+        assert any("tpyo_event" in e for e in errors)
+        assert any("tpyo_metric" in e for e in errors)
+
+    def test_registry_is_frozen_and_versioned(self):
+        assert isinstance(SCHEMA_VERSION, int) and SCHEMA_VERSION >= 1
+        assert "boots" in obs_schema.EVENT_KINDS
+        assert "level" in obs_schema.SPAN_NAMES
+        assert "boots_completed" in obs_schema.METRIC_NAMES
+
+
+class TestApiRunRecord:
+    @pytest.mark.smoke
+    def test_consensus_clust_attaches_record(self, tmp_path):
+        from consensusclustr_tpu.api import consensus_clust
+
+        rng = np.random.default_rng(0)
+        centers = rng.normal(0, 6, size=(3, 6))
+        pca = (
+            centers[rng.integers(0, 3, size=96)] + rng.normal(0, 1, (96, 6))
+        ).astype(np.float32)
+        path = str(tmp_path / "run.jsonl")
+        res = consensus_clust(
+            pca=pca, pc_num=6, nboots=2, k_num=(5,), res_range=(0.3, 0.9),
+            max_clusters=16, test_significance=False, run_record_path=path,
+        )
+        rec = res.run_record
+        assert rec is not None and rec.schema == SCHEMA_VERSION
+        phases = rec.phase_seconds()
+        assert {"ingest", "level", "assemble"} <= set(phases)
+        # the span tree nests the pipeline stages under the level span
+        level = next(s for s in rec.spans if s.name == "level")
+        names = {sp.name for _, sp in level.walk()}
+        assert {"consensus", "boots"} <= names
+        assert rec.metrics["counters"]["boots_completed"] >= 2
+        # run_record_path sink wrote a loadable JSONL line
+        assert load_records(path)[0].phase_seconds().keys() == phases.keys()
+        # spans account for (nearly) the whole run
+        assert sum(phases.values()) >= 0.8 * rec.wall_s

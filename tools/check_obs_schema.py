@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Static observability-schema check (invoked from the tier-1 suite).
+
+Scans the package sources (and bench.py) for literal event/span/metric names:
+
+    log.event("boots", ...)          -> obs.schema.EVENT_KINDS
+    tracer.span("cocluster")         -> obs.schema.SPAN_NAMES
+    maybe_span(log, "null_test")     -> obs.schema.SPAN_NAMES
+    metrics.counter("boots_completed") / .gauge / .histogram
+                                     -> obs.schema.METRIC_NAMES
+
+and fails on any name missing from the registry — a typo'd metric name
+becomes a test failure instead of a silently absent time series. Dynamic
+(non-literal) names are out of scope by design; the registry covers the
+package's own instrumentation, which is all literal.
+
+Usage: python tools/check_obs_schema.py [repo_root]
+Exit 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from consensusclustr_tpu.obs import schema  # noqa: E402
+
+EVENT_RE = re.compile(r"""\.event\(\s*["']([A-Za-z0-9_]+)["']""")
+SPAN_RE = re.compile(r"""\.span\(\s*["']([A-Za-z0-9_]+)["']""")
+MAYBE_SPAN_RE = re.compile(
+    r"""maybe_span\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
+)
+METRIC_RE = re.compile(
+    r"""\.(counter|gauge|histogram)\(\s*["']([A-Za-z0-9_]+)["']"""
+)
+
+# Scanned trees/files, relative to the repo root. Tests are exempt (they
+# exercise the machinery with throwaway names on purpose).
+SCAN = ("consensusclustr_tpu", "bench.py")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for target in SCAN:
+        path = os.path.join(root, target)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, _, names in os.walk(path):
+            out.extend(
+                os.path.join(dirpath, n) for n in names if n.endswith(".py")
+            )
+    return sorted(out)
+
+
+def check(root: str) -> List[str]:
+    """All schema violations under ``root`` as "file:line: message" strings."""
+    errors: List[str] = []
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in EVENT_RE.finditer(line):
+                    if m.group(1) not in schema.EVENT_KINDS:
+                        errors.append(
+                            f"{rel}:{lineno}: event kind {m.group(1)!r} not in "
+                            "obs.schema.EVENT_KINDS"
+                        )
+                for regex in (SPAN_RE, MAYBE_SPAN_RE):
+                    for m in regex.finditer(line):
+                        if m.group(1) not in schema.SPAN_NAMES:
+                            errors.append(
+                                f"{rel}:{lineno}: span name {m.group(1)!r} not "
+                                "in obs.schema.SPAN_NAMES"
+                            )
+                for m in METRIC_RE.finditer(line):
+                    if m.group(2) not in schema.METRIC_NAMES:
+                        errors.append(
+                            f"{rel}:{lineno}: metric name {m.group(2)!r} "
+                            f"({m.group(1)}) not in obs.schema.METRIC_NAMES"
+                        )
+    return errors
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else _ROOT
+    errors = check(root)
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} schema violation(s)")
+        return 1
+    print("obs schema clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Render a human-readable report from a RunRecord JSONL file.
+
+Usage:
+    python tools/report.py RUN_RECORD.jsonl            # last record
+    python tools/report.py RUN_RECORD.jsonl --index 0  # first record
+    python tools/report.py RUN_RECORD.jsonl --all      # every record
+
+Produces: a per-phase table (top-level spans, seconds, % of wall), a
+flamegraph-style text rendering of the span tree, error events, and the
+metrics snapshot.
+
+Deliberately standalone — parses the schema-versioned JSON directly, no
+package (or jax) import, so it runs anywhere a record file lands (including
+hosts without the accelerator stack).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+KNOWN_SCHEMAS = (1,)
+BAR_WIDTH = 24
+
+
+def load(path: str) -> List[dict]:
+    records = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{ln}: not valid JSON ({e})")
+    if not records:
+        raise SystemExit(f"{path}: no records")
+    return records
+
+
+def _span_total(spans: List[dict]) -> float:
+    return sum(s.get("seconds") or 0.0 for s in spans)
+
+
+def _phases(record: dict) -> dict:
+    # records carry a precomputed top-level breakdown; fall back to deriving
+    # it from the span tree for hand-rolled files
+    if record.get("phases"):
+        return record["phases"]
+    out: dict = {}
+    for s in record.get("spans", []):
+        if s.get("seconds") is not None:
+            out[s["name"]] = out.get(s["name"], 0.0) + s["seconds"]
+    return out
+
+
+def _bar(frac: float) -> str:
+    n = max(0, min(BAR_WIDTH, round(frac * BAR_WIDTH)))
+    return "#" * n + "." * (BAR_WIDTH - n)
+
+
+def phase_table(record: dict) -> str:
+    wall = record.get("wall_s") or _span_total(record.get("spans", [])) or 1e-9
+    phases = _phases(record)
+    counts: dict = {}
+    for s in record.get("spans", []):
+        counts[s["name"]] = counts.get(s["name"], 0) + 1
+    lines = [f"{'phase':<22} {'calls':>5} {'seconds':>10} {'% wall':>7}"]
+    for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+        lines.append(
+            f"{name:<22} {counts.get(name, 1):>5} {secs:>10.3f} "
+            f"{100.0 * secs / wall:>6.1f}%"
+        )
+    covered = sum(phases.values())
+    lines.append(
+        f"{'(unattributed)':<22} {'':>5} {max(wall - covered, 0.0):>10.3f} "
+        f"{100.0 * max(wall - covered, 0.0) / wall:>6.1f}%"
+    )
+    return "\n".join(lines)
+
+
+def flame(record: dict) -> str:
+    """Flamegraph-style text tree: indentation = nesting, bar = share of the
+    run's wall clock."""
+    wall = record.get("wall_s") or _span_total(record.get("spans", [])) or 1e-9
+    lines: List[str] = []
+
+    def walk(span: dict, depth: int) -> None:
+        secs = span.get("seconds")
+        frac = (secs or 0.0) / wall
+        mark = "" if span.get("ok", True) else f"  !! {span.get('error')}"
+        attrs = span.get("attrs") or {}
+        extra = (
+            " " + ",".join(f"{k}={v}" for k, v in attrs.items()) if attrs else ""
+        )
+        label = "  " * depth + span.get("name", "?")
+        secs_s = f"{secs:.3f}s" if secs is not None else "open"
+        lines.append(f"{label:<34} {secs_s:>10}  |{_bar(frac)}|{extra}{mark}")
+        for child in span.get("children", []):
+            walk(child, depth + 1)
+
+    for s in record.get("spans", []):
+        walk(s, 0)
+    return "\n".join(lines) if lines else "(no spans)"
+
+
+def metrics_summary(record: dict) -> str:
+    m = record.get("metrics") or {}
+    lines: List[str] = []
+    for name, v in (m.get("counters") or {}).items():
+        lines.append(f"counter   {name:<28} {v:g}")
+    for name, v in (m.get("gauges") or {}).items():
+        lines.append(f"gauge     {name:<28} {v if v is not None else '-'}")
+    for name, h in (m.get("histograms") or {}).items():
+        mean = h.get("mean")
+        lines.append(
+            f"histogram {name:<28} n={h.get('count')} mean="
+            f"{mean:.4f}" if mean is not None else
+            f"histogram {name:<28} n={h.get('count')}"
+        )
+    return "\n".join(lines) if lines else "(no metrics)"
+
+
+def render(record: dict) -> str:
+    schema = record.get("schema")
+    head = (
+        f"RunRecord schema={schema} backend={record.get('backend')} "
+        f"config={record.get('config_fingerprint')} wall={record.get('wall_s')}s"
+    )
+    if schema not in KNOWN_SCHEMAS:
+        head += f"\nWARNING: unknown schema {schema!r} (this tool knows {KNOWN_SCHEMAS})"
+    errors = [
+        e for e in record.get("events", [])
+        if e.get("ok") is False or "error" in e
+    ]
+    parts = [
+        head,
+        "", "== per-phase ==", phase_table(record),
+        "", "== span tree ==", flame(record),
+        "", "== metrics ==", metrics_summary(record),
+        "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
+    ]
+    for e in errors[:10]:
+        parts.append(f"  t={e.get('t')} {e.get('kind')}: {e.get('error', '?')}")
+    return "\n".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="RunRecord JSONL file")
+    ap.add_argument("--index", type=int, default=-1,
+                    help="which record to render (default: last)")
+    ap.add_argument("--all", action="store_true", help="render every record")
+    args = ap.parse_args(argv)
+    records = load(args.path)
+    picked = records if args.all else [records[args.index]]
+    out = []
+    for i, rec in enumerate(picked):
+        if len(picked) > 1:
+            out.append(f"--- record {i} ---")
+        out.append(render(rec))
+    print("\n".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,34 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _pipeline_depth() -> int:
+    """Resolved CCTPU_PIPELINE_DEPTH, guarded for the failure rung (the env
+    value or even the package import may be broken; the JSON line must
+    still emit)."""
+    try:
+        from consensusclustr_tpu.parallel.pipelined import pipeline_depth
+
+        return pipeline_depth()
+    except Exception:
+        return 0
+
+
+def _overlap_ratio(spans) -> float:
+    """Per-run overlap ratio from the span tree: total `overlap_seconds`
+    (device compute in flight while the host worked — the pipelined chunk
+    loops stamp it on their boots / null_sims spans) over those spans'
+    wall seconds. 0.0 when nothing pipelined ran; can exceed 1.0 when
+    depth > 2 keeps multiple chunks in flight simultaneously."""
+    overlap = seconds = 0.0
+    for root in spans or []:
+        for _, sp in root.walk():
+            attrs = sp.attrs or {}
+            if "overlap_seconds" in attrs and "pipeline_depth" in attrs:
+                overlap += float(attrs["overlap_seconds"])
+                seconds += float(sp.seconds or 0.0)
+    return round(overlap / seconds, 4) if seconds > 0 else 0.0
+
+
 def _run_pbmc3k() -> dict:
     """BASELINE config 1: pbmc3k-shaped NB fixture (2,700 cells, realistic
     sparsity + depth variation), 100 bootstraps, pcNum=5, Leiden, full
@@ -111,6 +139,10 @@ def _run_pbmc3k() -> dict:
         "ari_vs_truth": round(ari, 4),
         "boots_per_sec": round(nboots / dt, 3),
         "phases": phases,
+        "pipeline_depth": _pipeline_depth(),
+        "overlap_ratio": _overlap_ratio(
+            res.run_record.spans if res.run_record is not None else []
+        ),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -172,6 +204,8 @@ def _run_granular() -> dict:
         "candidate_rows": b_eff,
         "n_clusters": int(res.n_clusters),
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
+        "pipeline_depth": _pipeline_depth(),
+        "overlap_ratio": _overlap_ratio(tracer.roots),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -278,6 +312,8 @@ def _run() -> dict:
         "boots": nboots,
         "wall_s": round(dt, 3),
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
+        "pipeline_depth": _pipeline_depth(),
+        "overlap_ratio": _overlap_ratio(tracer.roots),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -411,6 +447,8 @@ def main() -> None:
             "error": err.strip().splitlines()[-1][:300],
             # failure rung stays schema-comparable: empty phases, same keys
             "phases": {},
+            "pipeline_depth": _pipeline_depth(),
+            "overlap_ratio": 0.0,
             "obs_schema": _OBS_SCHEMA,
         }
     )

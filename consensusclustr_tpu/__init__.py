@@ -27,19 +27,14 @@ from consensusclustr_tpu.config import ClusterConfig, DEFAULT_RES_RANGE
 
 # A JAX_PLATFORMS=cpu process must never dial the accelerator plugin, but
 # the plugin's sitecustomize re-pins jax's config at interpreter start —
-# honor the env pin the moment the package is imported. Inlined (os-only,
-# jax only under the cpu pin) rather than importing utils.backend, whose
-# package __init__ would pull jax and defeat the lazy-import design below;
-# utils/backend.py::repin_cpu_from_env is the documented form of this check.
-import os as _os
+# honor the env pin the moment the package is imported. _env is jax-free at
+# import (os only; jax pulled solely under an active cpu pin), so the
+# lazy-import design below survives, and utils/backend.py shares the SAME
+# check instead of a drift-prone copy (ADVICE r5 #3).
+from consensusclustr_tpu._env import repin_cpu_from_env as _repin_cpu
 
-if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-    import jax as _jax
-
-    if _jax.config.jax_platforms != "cpu":
-        _jax.config.update("jax_platforms", "cpu")
-    del _jax
-del _os
+_repin_cpu()
+del _repin_cpu
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,37 @@
+"""Jax-free environment checks shared by the package root and utils.backend.
+
+A ``JAX_PLATFORMS=cpu`` process must never dial the accelerator plugin, but
+the plugin's sitecustomize re-pins jax's config at interpreter start — so the
+pin has to be re-asserted the moment the package is imported AND whenever the
+backend resolver runs. Those two call sites used to carry separate copies of
+the check (ADVICE r5 #3); this module is the single shared form. It imports
+only ``os`` at module load (keeping ``import consensusclustr_tpu`` cheap for
+non-pinned processes) and touches jax exclusively under an active cpu pin,
+where doing so is hang-free by construction: the cpu branch never probes a
+backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_env_pinned() -> bool:
+    """True when $JAX_PLATFORMS pins plain "cpu" (the only hang-free pin)."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def repin_cpu_from_env() -> None:
+    """If $JAX_PLATFORMS pins plain "cpu", force jax's config to match.
+
+    The platform plugin's sitecustomize sets jax_platforms="axon,cpu" at
+    interpreter start, overriding the env — so without this, a cpu-pinned
+    process's first device op still dials the accelerator plugin (which
+    blocks forever on a wedged link). Called at package import and from
+    utils.backend.default_backend's cpu branch.
+    """
+    if cpu_env_pinned():
+        import jax
+
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")

@@ -128,6 +128,12 @@ class ClusterConfig:
     # duplicates would inflate cluster sizes past the 50-cell trigger and
     # silhouettes past the threshold) and the outcome maps back by label.
     n_real_cells: Optional[int] = None
+    # Async chunk pipelining (parallel/pipelined.py): how many boot / null-sim
+    # chunks may be in flight on the device at once. None = $CCTPU_PIPELINE_DEPTH
+    # (default 2). Depth 1 reproduces strictly serial dispatch (and synchronous
+    # checkpoint writes); results are bit-identical at any depth — the window
+    # only changes when chunks are fetched, never what was dispatched.
+    pipeline_depth: Optional[int] = None
     # Dense [n, n] consensus-matrix assembly: None = auto (dense up to
     # 16384 cells, blockwise streaming above — consensus/blockwise.py), or
     # force with True/False. The blockwise path computes the consensus kNN
@@ -171,6 +177,10 @@ class ClusterConfig:
             self.mesh == "auto" or hasattr(self.mesh, "devices")
         ):
             raise ValueError("mesh must be None, 'auto', or a jax.sharding.Mesh")
+        if self.pipeline_depth is not None and self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1 (1 = serial); got {self.pipeline_depth}"
+            )
 
     def replace(self, **kw) -> "ClusterConfig":
         return dataclasses.replace(self, **kw)

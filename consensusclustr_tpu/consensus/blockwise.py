@@ -69,11 +69,15 @@ def _run_with_tile_fallback(jit_fn, arrays, static_tail, use_pallas, max_cluster
     if pallas:
         try:
             out = jit_fn(*arrays, *static_tail, "pallas", variant, interpret)
+            # block inside the try: with async dispatch a runtime failure
+            # (e.g. HBM OOM at execute time) only surfaces at the fetch —
+            # outside this block it would escape the fallback (ADVICE r5 #2)
+            jax.block_until_ready(out)
             from consensusclustr_tpu.ops import pallas_cocluster as _pc
 
             _pc.LAST_VARIANT = variant
             return out
-        except Exception as e:  # Mosaic compile or OOM: degrade, don't die
+        except Exception as e:  # Mosaic compile or runtime OOM: degrade, don't die
             warnings.warn(
                 f"Pallas blockwise tile failed ({type(e).__name__}: {e}); "
                 "falling back to the einsum tile",

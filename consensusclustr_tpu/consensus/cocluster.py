@@ -74,9 +74,13 @@ def coclustering_distance(
 
         try:
             out = pallas_coclustering_distance(labels, n_classes=max_clusters)
+            # block inside the try so async runtime failures (HBM OOM at
+            # execute time) also degrade instead of escaping at the caller's
+            # fetch — same fix as blockwise._run_with_tile_fallback
+            jax.block_until_ready(out)
             LAST_PATH = "pallas"
             return out
-        except Exception as e:  # Mosaic compile or OOM: degrade, don't die
+        except Exception as e:  # Mosaic compile or runtime OOM: degrade, don't die
             warnings.warn(
                 f"Pallas co-clustering kernel failed ({type(e).__name__}: {e}); "
                 "falling back to the einsum path",

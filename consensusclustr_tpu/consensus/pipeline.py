@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import functools
 import os
-import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -50,6 +49,11 @@ from consensusclustr_tpu.consensus.merge import (
     merge_unstable_clusters,
 )
 from consensusclustr_tpu.obs import maybe_span, metrics_of
+from consensusclustr_tpu.parallel.pipelined import (
+    AsyncChunkWriter,
+    ChunkPipeline,
+    pipeline_depth,
+)
 from consensusclustr_tpu.utils.backend import default_backend as _default_backend
 from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
@@ -201,48 +205,90 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
 
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
     mets = metrics_of(log)
+    depth = pipeline_depth(cfg.pipeline_depth)
+    # one-time upload: the per-chunk jnp.asarray this replaces re-staged the
+    # [n, d] matrix on every iteration when a caller passed a host array
+    pca_dev = jax.device_put(jnp.asarray(pca, jnp.float32))
     out_labels, out_scores = [], []
-    with maybe_span(log, "boots", nboots=cfg.nboots, chunk=chunk):
-        for s in range(0, cfg.nboots, chunk):
-            e = min(s + chunk, cfg.nboots)
-            if ckpt is not None:
-                cached = ckpt.load_chunk(s, e - s)
-                if cached is not None:
-                    if robust:
-                        out_labels.append(cached[0])
-                        out_scores.append(cached[1])
-                    else:  # chunks store the flattened candidate axis
-                        out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
-                        out_scores.append(cached[1].reshape(e - s, rows_per_boot))
-                    mets.counter("boots_resumed").inc(e - s)
-                    if log:
-                        log.event("boots_resumed", done=e, total=cfg.nboots)
-                    continue
-            # min_size=0: the reference never passes its minSize into the boot
-            # grids (:394-395 vs :650's minSize=0 default) — the 0.15 floor is
-            # inert here and only bites in the null sims (minSize=5).
-            t_chunk = time.perf_counter()
-            labels, scores = _boot_batch(
-                keys[s:e], idx[s:e], jnp.asarray(pca, jnp.float32), res_list, k_list,
-                jnp.float32(0.0),
-                len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS, robust, n,
-                cfg.cluster_fun, cfg.compute_dtype,
-            )
-            out_labels.append(np.asarray(labels))
-            out_scores.append(np.asarray(scores))
-            mets.counter("boots_completed").inc(e - s)
-            mets.counter("leiden_iters").inc(
-                (e - s) * len(k_list) * len(cfg.res_range) * DEFAULT_COMMUNITY_ITERS
-            )
-            mets.histogram("boot_chunk_seconds").observe(
-                time.perf_counter() - t_chunk
-            )
-            if ckpt is not None:
-                ckpt.save_chunk(
-                    s, out_labels[-1].reshape(-1, n), out_scores[-1].reshape(-1)
-                )
+    # Checkpoint serialization rides a background writer so disk IO never
+    # sits on the dispatch path; depth 1 keeps the synchronous write (serial
+    # behavior reproduced exactly). save_chunk stays atomic (tmp + replace)
+    # on the writer thread, so no torn files either way.
+    writer = AsyncChunkWriter() if (ckpt is not None and depth > 1) else None
+    pipe = ChunkPipeline(depth, metrics=mets)
+
+    def _consume(ent):
+        s, e = ent.meta
+        if ent.ready:  # checkpoint-resume chunk, already host data
+            cached = ent.fetch()
+            if robust:
+                out_labels.append(cached[0])
+                out_scores.append(cached[1])
+            else:  # chunks store the flattened candidate axis
+                out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
+                out_scores.append(cached[1].reshape(e - s, rows_per_boot))
+            mets.counter("boots_resumed").inc(e - s)
             if log:
-                log.event("boots", done=e, total=cfg.nboots)
+                log.event("boots_resumed", done=e, total=cfg.nboots)
+            return
+        labels_np, scores_np = ent.fetch()
+        out_labels.append(labels_np)
+        out_scores.append(scores_np)
+        mets.counter("boots_completed").inc(e - s)
+        mets.counter("leiden_iters").inc(
+            (e - s) * len(k_list) * len(cfg.res_range) * DEFAULT_COMMUNITY_ITERS
+        )
+        # dispatch -> fetch-complete latency: identical to the old serial
+        # timing at depth 1; includes overlapped device time at depth > 1
+        mets.histogram("boot_chunk_seconds").observe(ent.latency_seconds)
+        if ckpt is not None:
+            payload = (s, labels_np.reshape(-1, n), scores_np.reshape(-1))
+            if writer is not None:
+                writer.submit(ckpt.save_chunk, *payload)
+            else:
+                ckpt.save_chunk(*payload)
+        if log:
+            log.event("boots", done=e, total=cfg.nboots)
+
+    with maybe_span(
+        log, "boots", nboots=cfg.nboots, chunk=chunk, pipeline_depth=depth
+    ) as bsp:
+        try:
+            for s in range(0, cfg.nboots, chunk):
+                e = min(s + chunk, cfg.nboots)
+                if ckpt is not None:
+                    cached = ckpt.load_chunk(s, e - s)
+                    if cached is not None:
+                        pipe.put_ready(s, cached, meta=(s, e))
+                        continue
+                for ent in pipe.ready_for_dispatch():
+                    _consume(ent)
+                # min_size=0: the reference never passes its minSize into the
+                # boot grids (:394-395 vs :650's minSize=0 default) — the 0.15
+                # floor is inert here and only bites in the null sims
+                # (minSize=5).
+                chunk_dev = _boot_batch(
+                    keys[s:e], idx[s:e], pca_dev, res_list, k_list,
+                    jnp.float32(0.0),
+                    len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS,
+                    robust, n, cfg.cluster_fun, cfg.compute_dtype,
+                )
+                pipe.put(s, chunk_dev, meta=(s, e))
+            for ent in pipe.drain():
+                _consume(ent)
+        except BaseException:
+            # drain in-flight work and the writer queue so the ORIGINAL
+            # exception surfaces (not a later async leak / torn shutdown)
+            pipe.abort()
+            if writer is not None:
+                writer.close(raise_errors=False)
+            raise
+        if writer is not None:
+            writer.close()  # re-raises a latched checkpoint-write error
+        bsp.set(
+            overlap_seconds=round(pipe.overlap_seconds, 4),
+            max_inflight=pipe.max_inflight,
+        )
         labels = np.concatenate(out_labels, axis=0)
         scores = np.concatenate(out_scores, axis=0)
     if not robust:

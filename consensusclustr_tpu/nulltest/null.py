@@ -38,6 +38,7 @@ from consensusclustr_tpu.prep.sizefactors import (
     stabilize_size_factors,
 )
 from consensusclustr_tpu.obs import maybe_span, metrics_of
+from consensusclustr_tpu.parallel.pipelined import ChunkPipeline, pipeline_depth
 from consensusclustr_tpu.prep.transform import shifted_log
 from consensusclustr_tpu.utils.rng import sim_key
 
@@ -106,6 +107,7 @@ def generate_null_statistics(
     res_range=None,
     compute_dtype: str = "float32",
     log=None,
+    pipeline_depth_override: Optional[int] = None,
 ) -> np.ndarray:
     """n_sims null silhouettes, chunk-vmapped on device.
 
@@ -141,25 +143,52 @@ def generate_null_statistics(
         else jnp.zeros((n_cells, 1), jnp.float32)
     )
     keys = jax.vmap(lambda s: sim_key(key, s, round_id))(jnp.arange(n_sims))
+    depth = pipeline_depth(pipeline_depth_override)
+    mets = metrics_of(log)
+    pipe = ChunkPipeline(depth, metrics=mets)
     out = []
-    for s in range(0, n_sims, chunk):
-        e = min(s + chunk, n_sims)
+
+    def _consume(ent):
+        s, e = ent.meta
         # per-null-dataset span: at big n each chunk is minutes-to-hours, so
-        # the RunRecord localizes which simulation round ate the wall clock
+        # the RunRecord localizes which simulation round ate the wall clock.
+        # Under pipelining the span covers the blocking fetch (where the wall
+        # time goes), not the async dispatch; overlap_seconds records how
+        # long the chunk ran on device while the host was elsewhere.
         with maybe_span(
             log, "null_sim_chunk", round_id=round_id, start=s, end=e
         ) as sp:
-            stats = np.asarray(
-                _null_stat_batch(
+            stats = ent.fetch()
+            sp.set(overlap_seconds=round(ent.overlap_seconds, 4))
+            sp.value = stats
+        out.append(stats)
+        mets.counter("null_sims_completed").inc(e - s)
+        if log:
+            # hours-scale at big n: observability for long runs
+            log.event("null_sims", done=e, total=n_sims, round_id=round_id)
+
+    with maybe_span(
+        log, "null_sims", round_id=round_id, n_sims=n_sims, chunk=chunk,
+        pipeline_depth=depth,
+    ) as nsp:
+        try:
+            for s in range(0, n_sims, chunk):
+                e = min(s + chunk, n_sims)
+                for ent in pipe.ready_for_dispatch():
+                    _consume(ent)
+                stats_dev = _null_stat_batch(
                     keys[s:e], model, cov, res_list,
                     int(n_cells), int(pc_num), k_list, pool_sizes,
                     int(max_clusters), has_cov, cluster_fun, compute_dtype,
                 )
-            )
-            sp.value = stats
-        out.append(stats)
-        metrics_of(log).counter("null_sims_completed").inc(e - s)
-        if log:
-            # hours-scale at big n: observability for long runs
-            log.event("null_sims", done=e, total=n_sims, round_id=round_id)
+                pipe.put(s, stats_dev, meta=(s, e))
+            for ent in pipe.drain():
+                _consume(ent)
+        except BaseException:
+            pipe.abort()  # surface the original exception, not an async leak
+            raise
+        nsp.set(
+            overlap_seconds=round(pipe.overlap_seconds, 4),
+            max_inflight=pipe.max_inflight,
+        )
     return np.concatenate(out)

@@ -72,6 +72,7 @@ SPAN_NAMES = frozenset({
     "consensus_distributed",
     # nulltest/
     "null_test",
+    "null_sims",        # one pipelined chunk loop (per adaptive round)
     "null_sim_chunk",
 })
 
@@ -87,5 +88,7 @@ METRIC_NAMES = frozenset({
     "compile_cache_entries",    # gauge: cache-dir entries at enable time (warm-cache proxy)
     "device_bytes_in_use",      # gauge: jax device memory_stats() at record time
     "device_peak_bytes_in_use", # gauge: peak device memory, when the backend reports it
-    "boot_chunk_seconds",       # histogram: wall seconds per computed boot chunk
+    "boot_chunk_seconds",       # histogram: dispatch->fetch latency per computed boot chunk
+    "inflight_chunks",          # gauge: high-water mark of concurrently in-flight pipelined chunks
+    "chunk_overlap_seconds",    # histogram: per chunk, seconds between dispatch and the host blocking on its fetch
 })

@@ -61,6 +61,23 @@ BOOT_CHUNK = 8      # boots per accumulation step inside a block
 LAST_VARIANT: str = "mxu"
 
 
+def _aligned_ncls(n_classes: int) -> int:
+    """Sublane-aligned class count (multiple of 32, covering 0..n_classes-1).
+
+    Loud contract (matches the block % TILE check): labels must fit int8 and
+    the one-hot class axis is bounded at 128 — a larger request used to clamp
+    silently, undercounting agreement for labels >= 128 on the mxu variant.
+    Engine paths are gated upstream (max_clusters <= 127); this protects
+    direct callers.
+    """
+    if int(n_classes) > 128:
+        raise ValueError(
+            f"n_classes ({n_classes}) exceeds the Pallas kernels' int8 label "
+            "bound of 128; use the einsum path for larger max_clusters"
+        )
+    return max(32, -(-int(n_classes) // 32) * 32)
+
+
 def _kernel_mxu(
     li_ref, lj_ref, out_ref, agree_ref, union_ref, *, n_classes, zero_diag
 ):
@@ -313,7 +330,7 @@ def pallas_cocluster_rows(
         # tail rows of the output uninitialized (silent wrong kNN edges)
         raise ValueError(f"block ({block}) must be a multiple of TILE ({TILE})")
     # same sublane-aligned class-count normalization as the square entry
-    ncls = min(128, max(32, -(-int(n_classes) // 32) * 32))
+    ncls = _aligned_ncls(n_classes)
     rows8 = jax.lax.dynamic_slice(
         lab8, (jnp.int32(0), jnp.asarray(start, jnp.int32)), (b_pad, block)
     )
@@ -346,7 +363,8 @@ def pallas_coclustering_distance(
         raise ValueError(f"unknown pallas variant {variant!r}")
     LAST_VARIANT = variant
     # NCLS: cover labels 0..n_classes-1, sublane-aligned (multiple of 32),
-    # int8 bound 128. Padding classes one-hot to zero columns — harmless.
-    ncls = min(128, max(32, -(-int(n_classes) // 32) * 32))
+    # int8 bound 128 (ValueError above that — no silent clamp). Padding
+    # classes one-hot to zero columns — harmless.
+    ncls = _aligned_ncls(n_classes)
     labels = jnp.asarray(labels)
     return _pallas_cocluster(labels, ncls, variant, interpret)

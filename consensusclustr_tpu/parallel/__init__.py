@@ -12,6 +12,11 @@ from consensusclustr_tpu.parallel.mesh import (
     factor_devices,
 )
 from consensusclustr_tpu.parallel.boots import sharded_run_bootstraps
+from consensusclustr_tpu.parallel.pipelined import (
+    AsyncChunkWriter,
+    ChunkPipeline,
+    pipeline_depth,
+)
 from consensusclustr_tpu.parallel.cocluster import sharded_coclustering_distance
 from consensusclustr_tpu.parallel.knn import ring_knn, sharded_knn_from_distance
 from consensusclustr_tpu.parallel.step import (
@@ -27,6 +32,9 @@ __all__ = [
     "factor_devices",
     "sharded_run_bootstraps",
     "sharded_coclustering_distance",
+    "AsyncChunkWriter",
+    "ChunkPipeline",
+    "pipeline_depth",
     "ring_knn",
     "sharded_knn_from_distance",
     "DistributedStepResult",

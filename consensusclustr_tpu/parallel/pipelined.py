@@ -1,0 +1,262 @@
+"""Bounded-window async chunk pipeline: overlap device compute with host work.
+
+JAX dispatch is asynchronous — a jitted call returns device "futures"
+immediately and the chip executes in the background. The serial chunk drivers
+(`consensus/pipeline.run_bootstraps`, `nulltest/null.generate_null_statistics`)
+threw that away: they called ``np.asarray`` right after each dispatch, so the
+device idled through the whole host-transfer + checkpoint-IO tail of every
+chunk. This module keeps up to ``depth`` chunks in flight (dispatch chunk i+1
+while chunk i still executes), fetches results strictly in submission order,
+and moves checkpoint serialization onto a background writer thread so disk IO
+never sits on the dispatch path.
+
+Correctness contract:
+
+* Results are bit-identical to the serial path at any depth — the pipeline
+  changes *when* a chunk is fetched, never what was dispatched. Depth 1
+  reproduces today's serial behavior exactly (fetch before the next dispatch,
+  synchronous checkpoint writes).
+* A chunk that raises (at dispatch or at fetch) drains the in-flight window
+  (secondary errors swallowed) and surfaces the ORIGINAL exception.
+* Host-ready values (checkpoint-resume chunks) ride the same ordered window
+  without consuming a device slot, so resumed and computed chunks interleave
+  in chunk order.
+
+Observability (names registered in obs/schema.py):
+
+* ``inflight_chunks`` gauge — high-water mark of concurrently in-flight
+  dispatched chunks (window occupancy; ``depth`` when the pipeline filled).
+* ``chunk_overlap_seconds`` histogram — per chunk, the seconds between its
+  dispatch and the moment the host blocked on its fetch: the window in which
+  device compute could overlap host work (fetch of earlier chunks, checkpoint
+  IO, the next dispatch). An upper bound on realized overlap; ~0 at depth 1.
+
+The window knob is ``CCTPU_PIPELINE_DEPTH`` (default 2), overridable per call
+(``ClusterConfig.pipeline_depth`` / the ``pipeline_depth=`` arguments).
+Depth >2 only helps when a single chunk's host tail (fetch + IO) exceeds a
+full chunk's device time — see docs/perf.md "Pipelined chunk execution".
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+
+DEFAULT_PIPELINE_DEPTH = 2
+
+
+def pipeline_depth(requested: Optional[int] = None) -> int:
+    """Resolve the window depth: explicit arg > $CCTPU_PIPELINE_DEPTH > 2.
+
+    Loud contract: a depth < 1 is a configuration error, not a clamp — depth
+    1 is the serial pipeline, there is nothing below it.
+    """
+    if requested is None:
+        requested = int(os.environ.get("CCTPU_PIPELINE_DEPTH", DEFAULT_PIPELINE_DEPTH))
+    depth = int(requested)
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1; got {depth}")
+    return depth
+
+
+def _fetch_host(payload: Any) -> Any:
+    """Blocking device->host transfer of a pytree of arrays."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, payload)
+
+
+class AsyncChunkWriter:
+    """Single background thread draining a queue of write callables.
+
+    Serialization (np.savez + atomic os.replace in BootCheckpoint.save_chunk)
+    runs off the dispatch path; submission order is preserved, so chunk files
+    land in the order they were produced. The first error is latched and
+    re-raised on the next ``submit`` (stopping the producer loop promptly) or
+    at ``close`` — a full disk fails the run instead of silently dropping
+    checkpoints.
+    """
+
+    def __init__(self, name: str = "cctpu-chunk-writer") -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args, kwargs = item
+            if self._error is None:  # after an error, drain without writing
+                try:
+                    fn(*args, **kwargs)
+                except BaseException as e:  # latched, re-raised on the host thread
+                    self._error = e
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> None:
+        if self._closed:
+            raise RuntimeError("AsyncChunkWriter already closed")
+        self._raise_pending()
+        self._q.put((fn, args, kwargs))
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Flush the queue, join the thread; re-raise any latched write error."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join()
+        if raise_errors:
+            self._raise_pending()
+
+
+class PendingChunk:
+    """One window slot: a dispatched chunk's device output, or a host-ready
+    value (resume path). ``fetch()`` blocks until the value is on host;
+    idempotent, and always returns entries' values in submission order when
+    driven through :class:`ChunkPipeline`.
+    """
+
+    __slots__ = (
+        "index", "meta", "ready", "overlap_seconds", "latency_seconds",
+        "_payload", "_value", "_fetched", "_dispatched_at", "_pipe",
+    )
+
+    def __init__(self, pipe: "ChunkPipeline", index: int, payload: Any,
+                 meta: Any, ready: bool) -> None:
+        self._pipe = pipe
+        self.index = index
+        self.meta = meta
+        self.ready = ready
+        self._payload = payload
+        self._value = payload if ready else None
+        self._fetched = ready
+        self._dispatched_at = time.perf_counter()
+        self.overlap_seconds = 0.0
+        self.latency_seconds = 0.0
+
+    def fetch(self) -> Any:
+        """Host value of this chunk; blocks on the device the first time."""
+        if not self._fetched:
+            t_wait = time.perf_counter()
+            self.overlap_seconds = t_wait - self._dispatched_at
+            self._pipe._record_fetch_start(self)
+            self._value = _fetch_host(self._payload)
+            self._payload = None
+            self._fetched = True
+            self.latency_seconds = time.perf_counter() - self._dispatched_at
+            self._pipe._record_fetch_done(self)
+        return self._value
+
+
+class ChunkPipeline:
+    """Ordered bounded window of in-flight chunks.
+
+    Driver shape (see run_bootstraps / generate_null_statistics):
+
+        pipe = ChunkPipeline(depth, metrics=mets)
+        try:
+            for s in chunk_starts:
+                for ent in pipe.ready_for_dispatch():
+                    consume(ent)                  # fetch + post-process
+                pipe.put(s, dispatch_chunk(s))    # async jitted call
+            for ent in pipe.drain():
+                consume(ent)
+        except BaseException:
+            pipe.abort()
+            raise
+
+    ``ready_for_dispatch`` yields the oldest entries until a new dispatch
+    fits under ``depth``; each yielded entry must be ``fetch()``ed before the
+    iterator is advanced (the driver loops above do). Host-ready entries
+    (``put_ready``) occupy the ordered window but not a device slot.
+    """
+
+    def __init__(self, depth: int, metrics: Optional[MetricsRegistry] = None):
+        self.depth = int(depth)
+        if self.depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1; got {self.depth}")
+        self._metrics = metrics
+        self._window: "deque[PendingChunk]" = deque()
+        self._inflight = 0
+        self.max_inflight = 0
+        self.overlap_seconds = 0.0
+        self.chunks_fetched = 0
+
+    # -- bookkeeping (called by PendingChunk.fetch) --------------------------
+
+    def _record_fetch_start(self, ent: PendingChunk) -> None:
+        self.overlap_seconds += ent.overlap_seconds
+        if self._metrics is not None:
+            self._metrics.histogram("chunk_overlap_seconds").observe(
+                ent.overlap_seconds
+            )
+
+    def _record_fetch_done(self, ent: PendingChunk) -> None:
+        self._inflight -= 1
+        self.chunks_fetched += 1
+
+    # -- producer side -------------------------------------------------------
+
+    def put(self, index: int, payload: Any, meta: Any = None) -> PendingChunk:
+        """Enqueue a freshly dispatched chunk (device arrays, not yet ready)."""
+        ent = PendingChunk(self, index, payload, meta, ready=False)
+        self._window.append(ent)
+        self._inflight += 1
+        if self._inflight > self.max_inflight:
+            self.max_inflight = self._inflight
+            if self._metrics is not None:
+                # high-water mark: a last-write gauge would always read 0
+                # after the drain, which is the only time records snapshot it
+                self._metrics.gauge("inflight_chunks").set(self.max_inflight)
+        return ent
+
+    def put_ready(self, index: int, value: Any, meta: Any = None) -> PendingChunk:
+        """Enqueue a host-ready value (resume cache) in chunk order."""
+        ent = PendingChunk(self, index, value, meta, ready=True)
+        self._window.append(ent)
+        return ent
+
+    # -- consumer side -------------------------------------------------------
+
+    def ready_for_dispatch(self) -> Iterator[PendingChunk]:
+        """Yield oldest entries until one more dispatch fits in the window."""
+        while self._window and self._inflight >= self.depth:
+            yield self._window.popleft()
+
+    def drain(self) -> Iterator[PendingChunk]:
+        """Yield every remaining entry, oldest first."""
+        while self._window:
+            yield self._window.popleft()
+
+    def abort(self) -> None:
+        """Quiesce after an error: block on in-flight work, swallow secondary
+        failures, clear the window — so the original exception surfaces
+        instead of an async error leaking into unrelated later code."""
+        while self._window:
+            ent = self._window.popleft()
+            if not ent._fetched:
+                self._inflight -= 1
+                try:
+                    import jax
+
+                    jax.block_until_ready(ent._payload)
+                except Exception:
+                    pass
+                ent._payload = None
+                ent._fetched = True

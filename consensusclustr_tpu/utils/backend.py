@@ -23,21 +23,10 @@ from __future__ import annotations
 
 import os
 
-
-def repin_cpu_from_env() -> None:
-    """If $JAX_PLATFORMS pins plain "cpu", force jax's config to match.
-
-    The platform plugin's sitecustomize sets jax_platforms="axon,cpu" at
-    interpreter start, overriding the env — so without this, a cpu-pinned
-    process's first device op still dials the accelerator plugin (which
-    blocks forever on a wedged link). Called at package import: the cpu
-    branch can never probe anything, so it is hang-free by construction.
-    """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        import jax
-
-        if jax.config.jax_platforms != "cpu":
-            jax.config.update("jax_platforms", "cpu")
+# Single source of truth for the cpu-pin check, shared with the package
+# root's import-time re-pin (ADVICE r5 #3: two inlined copies could drift).
+# Re-exported here because this module is the documented home of the check.
+from consensusclustr_tpu._env import cpu_env_pinned, repin_cpu_from_env  # noqa: F401
 
 
 def default_backend() -> str:
@@ -53,7 +42,7 @@ def default_backend() -> str:
     same correction for the pytest process).
     """
     env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if env == "cpu":
+    if cpu_env_pinned():
         repin_cpu_from_env()
         return "cpu"
     # For anything but an env cpu-pin, the live config is the more current

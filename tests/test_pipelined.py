@@ -1,0 +1,291 @@
+"""Pipelined chunk execution (parallel/pipelined.py) and its adoption sites.
+
+The contract under test (ISSUE 2): bit-identical results at any window depth,
+strict in-order consumption, bounded in-flight work, background checkpoint
+writes that never tear files, and original-exception propagation from a chunk
+that fails mid-flight.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+from consensusclustr_tpu.obs import Tracer
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+from consensusclustr_tpu.parallel.pipelined import (
+    DEFAULT_PIPELINE_DEPTH,
+    AsyncChunkWriter,
+    ChunkPipeline,
+    pipeline_depth,
+)
+from consensusclustr_tpu.utils.checkpoint import BootCheckpoint
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import root_key
+
+from conftest import make_blobs
+
+
+def _drive(pipe, n_chunks, put):
+    """The canonical driver loop: returns entries in consumption order."""
+    got = []
+    for i in range(n_chunks):
+        for ent in pipe.ready_for_dispatch():
+            got.append((ent.index, ent.fetch()))
+        put(pipe, i)
+    for ent in pipe.drain():
+        got.append((ent.index, ent.fetch()))
+    return got
+
+
+class TestChunkPipeline:
+    @pytest.mark.smoke
+    def test_window_bound_and_order(self):
+        reg = MetricsRegistry()
+        pipe = ChunkPipeline(2, metrics=reg)
+        got = _drive(
+            pipe, 5, lambda p, i: p.put(i, np.full((2,), i), meta=i)
+        )
+        assert [g[0] for g in got] == list(range(5))
+        for i, (_, val) in enumerate(got):
+            np.testing.assert_array_equal(val, np.full((2,), i))
+        assert pipe.max_inflight == 2  # window never exceeded depth
+        assert reg.gauge("inflight_chunks").value == 2
+        assert reg.histograms["chunk_overlap_seconds"].count == 5
+
+    def test_depth_one_is_serial(self):
+        pipe = ChunkPipeline(1)
+        order = []
+
+        def put(p, i):
+            # at depth 1 every prior chunk must be fetched before a new put
+            assert p._inflight == 0
+            p.put(i, np.asarray([i]))
+            order.append(i)
+
+        got = _drive(pipe, 4, put)
+        assert [g[0] for g in got] == order == list(range(4))
+        assert pipe.max_inflight == 1
+
+    def test_ready_entries_interleave_in_order(self):
+        # resume-cache entries (put_ready) hold window order without taking a
+        # device slot — mixed streams must still come out in chunk order
+        pipe = ChunkPipeline(2)
+
+        def put(p, i):
+            if i % 2 == 0:
+                p.put_ready(i, np.asarray([i]))
+            else:
+                p.put(i, np.asarray([i]))
+
+        got = _drive(pipe, 6, put)
+        assert [g[0] for g in got] == list(range(6))
+        assert pipe.max_inflight <= 2
+
+    def test_fetch_idempotent(self):
+        pipe = ChunkPipeline(2)
+        ent = pipe.put(0, np.asarray([7]))
+        first = ent.fetch()
+        assert ent.fetch() is first
+        assert pipe.chunks_fetched == 1
+
+    def test_abort_clears_window_without_raising(self):
+        pipe = ChunkPipeline(3)
+        for i in range(3):
+            pipe.put(i, np.asarray([i]))
+        pipe.abort()
+        assert list(pipe.drain()) == []
+        assert pipe._inflight == 0
+
+    @pytest.mark.smoke
+    def test_depth_resolution(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_PIPELINE_DEPTH", raising=False)
+        assert pipeline_depth() == DEFAULT_PIPELINE_DEPTH
+        monkeypatch.setenv("CCTPU_PIPELINE_DEPTH", "5")
+        assert pipeline_depth() == 5
+        assert pipeline_depth(1) == 1  # explicit beats env
+        with pytest.raises(ValueError):
+            pipeline_depth(0)
+        with pytest.raises(ValueError):
+            ChunkPipeline(0)
+        with pytest.raises(ValueError):
+            ClusterConfig(pipeline_depth=0)
+
+
+class TestAsyncChunkWriter:
+    def test_writes_in_order(self):
+        w = AsyncChunkWriter()
+        seen = []
+        for i in range(20):
+            w.submit(seen.append, i)
+        w.close()
+        assert seen == list(range(20))
+
+    def test_error_surfaces_on_close(self):
+        w = AsyncChunkWriter()
+
+        def boom():
+            raise OSError("disk full")
+
+        w.submit(boom)
+        with pytest.raises(OSError, match="disk full"):
+            w.close()
+        with pytest.raises(RuntimeError):
+            w.submit(print)  # closed writer refuses new work
+
+
+def _boot_cfg(**kw):
+    return ClusterConfig(
+        nboots=6, k_num=(5,), res_range=(0.2, 0.5), max_clusters=16,
+        boot_batch=2, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def small_pca():
+    x, _ = make_blobs(n_per=16, n_genes=8, n_clusters=3, seed=11)
+    return x[:, :4].astype(np.float32)
+
+
+class TestPipelinedBoots:
+    @pytest.mark.smoke
+    def test_depth_parity_robust(self, small_pca):
+        key = root_key(7)
+        ref_l, ref_s = run_bootstraps(key, small_pca, _boot_cfg(pipeline_depth=1))
+        for d in (2, 4):
+            lab, sc = run_bootstraps(
+                key, small_pca, _boot_cfg(pipeline_depth=d)
+            )
+            np.testing.assert_array_equal(lab, ref_l)
+            np.testing.assert_array_equal(np.asarray(sc), np.asarray(ref_s))
+
+    def test_depth_parity_granular(self, small_pca):
+        key = root_key(8)
+        cfgs = [
+            _boot_cfg(mode="granular", pipeline_depth=d) for d in (1, 2, 4)
+        ]
+        ref_l, ref_s = run_bootstraps(key, small_pca, cfgs[0])
+        assert ref_l.shape == (6 * 1 * 2, small_pca.shape[0])
+        for cfg in cfgs[1:]:
+            lab, sc = run_bootstraps(key, small_pca, cfg)
+            np.testing.assert_array_equal(lab, ref_l)
+            np.testing.assert_array_equal(np.asarray(sc), np.asarray(ref_s))
+
+    def test_boots_span_carries_pipeline_attrs(self, small_pca):
+        tr = Tracer()
+        run_bootstraps(
+            root_key(7), small_pca, _boot_cfg(pipeline_depth=2),
+            log=LevelLog(tracer=tr),
+        )
+        boots = [s for s in tr.roots if s.name == "boots"]
+        assert len(boots) == 1
+        assert boots[0].attrs["pipeline_depth"] == 2
+        assert boots[0].attrs["overlap_seconds"] >= 0.0
+        assert boots[0].attrs["max_inflight"] <= 2
+        assert tr.metrics.gauge("inflight_chunks").value >= 1
+        assert tr.metrics.histograms["chunk_overlap_seconds"].count == 3
+
+    def test_checkpoint_resume_with_background_writer(self, small_pca, tmp_path):
+        key = root_key(9)
+        want, want_s = run_bootstraps(key, small_pca, _boot_cfg(pipeline_depth=3))
+        cfg = _boot_cfg(pipeline_depth=3, checkpoint_dir=str(tmp_path))
+        got, _ = run_bootstraps(key, small_pca, cfg)
+        np.testing.assert_array_equal(got, want)
+        (sub,) = os.listdir(tmp_path)  # one fingerprint directory
+        files = sorted(os.listdir(tmp_path / sub))
+        # the background writer landed every chunk atomically: no torn tmps,
+        # all three chunk files present
+        assert not any(f.endswith(".tmp.npz") for f in files)
+        assert [f for f in files if f.startswith("boots_")] == [
+            "boots_000000.npz", "boots_000002.npz", "boots_000004.npz",
+        ]
+        # kill a middle chunk: the rerun resumes around the hole and the
+        # cached/computed interleave is still bit-identical and in order
+        os.unlink(tmp_path / sub / "boots_000002.npz")
+        log = LevelLog()
+        again, again_s = run_bootstraps(key, small_pca, cfg, log=log)
+        np.testing.assert_array_equal(again, want)
+        np.testing.assert_allclose(np.asarray(again_s), np.asarray(want_s), atol=1e-6)
+        kinds = [r["kind"] for r in log.records if r["kind"].startswith("boots")]
+        assert "boots_resumed" in kinds and "boots" in kinds
+
+    def test_chunk_exception_propagates_and_drains(self, small_pca, tmp_path, monkeypatch):
+        import consensusclustr_tpu.consensus.pipeline as cp
+
+        real = cp._boot_batch
+        calls = {"n": 0}
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise RuntimeError("chunk exploded")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(cp, "_boot_batch", flaky)
+        cfg = _boot_cfg(pipeline_depth=3, checkpoint_dir=str(tmp_path))
+        with pytest.raises(RuntimeError, match="chunk exploded"):
+            run_bootstraps(root_key(10), small_pca, cfg)
+        # the writer was drained and closed: whatever chunks landed are whole
+        for sub in os.listdir(tmp_path):
+            for f in os.listdir(tmp_path / sub):
+                assert not f.endswith(".tmp.npz")
+
+    def test_checkpoint_write_error_propagates(self, small_pca, tmp_path, monkeypatch):
+        def boom(self, *a, **kw):
+            raise OSError("no space left on device")
+
+        monkeypatch.setattr(BootCheckpoint, "save_chunk", boom)
+        cfg = _boot_cfg(pipeline_depth=2, checkpoint_dir=str(tmp_path))
+        with pytest.raises(OSError, match="no space left"):
+            run_bootstraps(root_key(11), small_pca, cfg)
+
+
+class TestPipelinedNulls:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from consensusclustr_tpu.nulltest.copula import CopulaModel
+
+        g = 4
+        return CopulaModel(
+            mu=jnp.full((g,), 5.0, jnp.float32),
+            theta=jnp.full((g,), 2.0, jnp.float32),
+            chol=jnp.eye(g, dtype=jnp.float32),
+        )
+
+    def test_null_stats_depth_parity(self, model):
+        from consensusclustr_tpu.nulltest import generate_null_statistics
+
+        ref = None
+        for d in (1, 2, 4):
+            stats = generate_null_statistics(
+                jax.random.key(0), model, n_cells=40, pc_num=3, n_sims=5,
+                k_num=(5,), max_clusters=16, chunk=2, res_range=(0.3, 0.8),
+                pipeline_depth_override=d,
+            )
+            if ref is None:
+                ref = stats
+            else:
+                np.testing.assert_array_equal(stats, ref)
+        assert ref.shape == (5,)
+
+    def test_null_sims_span_wraps_chunks(self, model):
+        from consensusclustr_tpu.nulltest import generate_null_statistics
+
+        tr = Tracer()
+        generate_null_statistics(
+            jax.random.key(1), model, n_cells=40, pc_num=3, n_sims=4,
+            k_num=(5,), max_clusters=16, chunk=2, res_range=(0.3, 0.8),
+            pipeline_depth_override=2, log=LevelLog(tracer=tr),
+        )
+        (outer,) = [s for s in tr.roots if s.name == "null_sims"]
+        assert outer.attrs["pipeline_depth"] == 2
+        assert outer.attrs["overlap_seconds"] >= 0.0
+        chunks = [c for c in outer.children if c.name == "null_sim_chunk"]
+        assert [(c.attrs["start"], c.attrs["end"]) for c in chunks] == [(0, 2), (2, 4)]
+        assert all("overlap_seconds" in c.attrs for c in chunks)
+        assert tr.metrics.counters["null_sims_completed"].value == 4

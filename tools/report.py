@@ -108,6 +108,36 @@ def flame(record: dict) -> str:
     return "\n".join(lines) if lines else "(no spans)"
 
 
+def pipelining(record: dict) -> str:
+    """Overlap ratio per pipelined phase: spans stamped with both
+    pipeline_depth and overlap_seconds (the boots / null_sims chunk loops).
+    ratio = overlap_seconds / span seconds — the fraction of the phase during
+    which device compute was in flight while the host worked; > 1.0 means
+    several chunks were in flight simultaneously (depth > 2). Child spans
+    (null_sim_chunk) carry only overlap_seconds and are skipped so overlap is
+    never double-counted."""
+    lines: List[str] = []
+
+    def walk(span: dict, path: str) -> None:
+        p = f"{path}/{span.get('name', '?')}" if path else span.get("name", "?")
+        attrs = span.get("attrs") or {}
+        if "overlap_seconds" in attrs and "pipeline_depth" in attrs:
+            secs = span.get("seconds") or 0.0
+            overlap = float(attrs["overlap_seconds"])
+            ratio = overlap / secs if secs > 0 else 0.0
+            lines.append(
+                f"{p:<40} depth={attrs['pipeline_depth']:<3} "
+                f"inflight_max={attrs.get('max_inflight', '-'):<3} "
+                f"overlap={overlap:>8.3f}s  ratio={ratio:>6.2f}"
+            )
+        for child in span.get("children", []):
+            walk(child, p)
+
+    for s in record.get("spans", []):
+        walk(s, "")
+    return "\n".join(lines) if lines else "(no pipelined phases)"
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -141,6 +171,7 @@ def render(record: dict) -> str:
         head,
         "", "== per-phase ==", phase_table(record),
         "", "== span tree ==", flame(record),
+        "", "== pipelining ==", pipelining(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]

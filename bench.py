@@ -60,9 +60,105 @@ if os.environ.get("CCTPU_FORCE_CPU"):
 NORTH_STAR_BOOTS_PER_SEC = 1000.0 / 60.0
 _RETRY_FLAG = "CCTPU_BENCH_CPU_RETRY"
 
+# The serving rung's zero shape — emitted verbatim on the failure rung so
+# BENCH_*.json lines stay key-comparable across PRs.
+_SERVING_ZERO = {
+    "qps": 0.0,
+    "latency_p50_ms": 0.0,
+    "latency_p99_ms": 0.0,
+    "bucket_compiles": 0,
+}
+
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def _serving_rung() -> dict:
+    """Online-assignment micro-bench (serve/): synthetic frozen reference →
+    artifact save/load round trip (checksum exercised) → AssignmentService →
+    micro-batched queries of mixed sizes. Reports requests/sec (qps),
+    client-observed p50/p99 latency, and how many bucket shapes compiled —
+    the executables-reused-across-request-sizes claim, measured.
+
+    The reference model is synthetic (random loadings + labels): this rung
+    measures serving MECHANICS (compile reuse, queue, vote kernel), which do
+    not depend on fit quality; the offline rungs measure fitting. Shapes via
+    BENCH_SERVE_REF / BENCH_SERVE_GENES / BENCH_SERVE_REQUESTS. Never
+    raises: any failure returns the zero shape with an error note.
+    """
+    try:
+        import tempfile
+
+        from consensusclustr_tpu.serve.artifact import (
+            ReferenceArtifact,
+            level_tables,
+        )
+        from consensusclustr_tpu.serve.assign import embed_reference_counts
+        from consensusclustr_tpu.serve.service import (
+            AssignmentService,
+            RetryableRejection,
+        )
+
+        rng = np.random.default_rng(0)
+        n_ref = int(os.environ.get("BENCH_SERVE_REF", 2048))
+        g = int(os.environ.get("BENCH_SERVE_GENES", 256))
+        n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
+        d, n_classes, max_batch = 10, 8, 64
+
+        loadings = np.linalg.qr(rng.normal(size=(g, d)))[0].astype(np.float32)
+        mu = rng.gamma(1.0, 1.0, g).astype(np.float32)
+        sigma = np.ones(g, np.float32)
+        ref_counts = rng.poisson(2.0, size=(n_ref, g)).astype(np.float32)
+        libsize_mean = float(ref_counts.sum(axis=1).mean())
+        emb = embed_reference_counts(ref_counts, mu, sigma, loadings, libsize_mean)
+        codes, tables = level_tables(
+            np.asarray([str(c + 1) for c in rng.integers(0, n_classes, n_ref)])
+        )
+        art = ReferenceArtifact(
+            embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+            libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+            stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            art.save(tmp)
+            art = ReferenceArtifact.load(tmp)
+
+        sizes = rng.integers(1, max_batch + 1, size=n_req)
+        queries = [
+            rng.poisson(2.0, size=(int(s), g)).astype(np.float32) for s in sizes
+        ]
+        lat: list = []
+        with AssignmentService(
+            art, max_batch=max_batch, queue_depth=16, warmup=True
+        ) as svc:
+            t0 = time.perf_counter()
+            futs = []
+            for q in queries:
+                t_sub = time.perf_counter()
+                while True:
+                    try:
+                        futs.append((t_sub, svc.submit(q)))
+                        break
+                    except RetryableRejection:
+                        time.sleep(0.001)
+            for t_sub, f in futs:
+                f.result(timeout=300)
+                lat.append(time.perf_counter() - t_sub)
+            wall = time.perf_counter() - t0
+            compiles = svc.bucket_compiles
+        lat_ms = np.sort(np.asarray(lat)) * 1000.0
+        return {
+            "qps": round(n_req / wall, 2),
+            "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "bucket_compiles": int(compiles),
+            "cells_per_sec": round(float(sizes.sum()) / wall, 1),
+            "requests": n_req,
+            "ref_cells": n_ref,
+        }
+    except Exception as e:
+        return dict(_SERVING_ZERO, error=str(e)[:200])
 
 
 def _pipeline_depth() -> int:
@@ -143,6 +239,7 @@ def _run_pbmc3k() -> dict:
         "overlap_ratio": _overlap_ratio(
             res.run_record.spans if res.run_record is not None else []
         ),
+        "serving": _serving_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -206,6 +303,7 @@ def _run_granular() -> dict:
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
+        "serving": _serving_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -314,6 +412,7 @@ def _run() -> dict:
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
+        "serving": _serving_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -449,6 +548,7 @@ def main() -> None:
             "phases": {},
             "pipeline_depth": _pipeline_depth(),
             "overlap_ratio": 0.0,
+            "serving": dict(_SERVING_ZERO),
             "obs_schema": _OBS_SCHEMA,
         }
     )

@@ -48,6 +48,12 @@ _LAZY = {
     "CountMatrix": ("consensusclustr_tpu.io", "CountMatrix"),
     "load_counts": ("consensusclustr_tpu.io", "load_counts"),
     "load_10x": ("consensusclustr_tpu.io", "load_10x"),
+    # serving surface (serve/): export a fitted run, query it online
+    "export_reference": ("consensusclustr_tpu.api", "export_reference"),
+    "assign_cells": ("consensusclustr_tpu.api", "assign_cells"),
+    "load_reference": ("consensusclustr_tpu.serve.artifact", "load_reference"),
+    "ReferenceArtifact": ("consensusclustr_tpu.serve.artifact", "ReferenceArtifact"),
+    "AssignmentService": ("consensusclustr_tpu.serve.service", "AssignmentService"),
 }
 
 
@@ -60,14 +66,19 @@ def __getattr__(name):
     raise AttributeError(f"module 'consensusclustr_tpu' has no attribute {name!r}")
 
 __all__ = [
+    "AssignmentService",
     "ClusterConfig",
     "DEFAULT_RES_RANGE",
     "CountMatrix",
+    "ReferenceArtifact",
+    "assign_cells",
     "consensus_clust",
+    "export_reference",
     "get_clust_assignments",
     "determine_hierarchy",
     "load_counts",
     "load_10x",
+    "load_reference",
     "test_splits",
     "__version__",
 ]

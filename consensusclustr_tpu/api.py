@@ -77,6 +77,12 @@ class ClusterResult:
     # Observability: span tree + events + metrics for this run (obs/).
     # Serialize with run_record.write(path); render with tools/report.py.
     run_record: Optional[RunRecord] = None
+    # Serving state (serve/artifact.ReferenceFit): frozen normalization +
+    # PCA components + serve-path embedding + per-cluster stability, captured
+    # when the run was fitted from raw counts. export_reference(result, path)
+    # turns it into a versioned on-disk bundle; None for pca=/norm_counts=
+    # -only runs (nothing to freeze).
+    fit: Optional[Any] = None
 
     @property
     def n_clusters(self) -> int:
@@ -363,9 +369,11 @@ def _level(
     cfg: ClusterConfig,
     log: LevelLog,
     depth: int,
-) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray]]:
+) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray], Optional[dict]]:
     """One level of the pipeline (reference :274-539): returns
-    (labels [n] of str, consensus result or None, pca or None).
+    (labels [n] of str, consensus result or None, pca or None, serving
+    capture dict or None — depth-1 frozen preprocessing state for
+    serve/artifact.ReferenceFit).
 
     Span-wrapped: each level is one "level" span; recursion nests child
     levels under the parent's tree in the RunRecord."""
@@ -379,7 +387,7 @@ def _level_impl(
     cfg: ClusterConfig,
     log: LevelLog,
     depth: int,
-) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray]]:
+) -> Tuple[np.ndarray, Optional[ConsensusResult], Optional[np.ndarray], Optional[dict]]:
     n = (
         ing.counts.shape[0]
         if ing.counts is not None
@@ -390,7 +398,7 @@ def _level_impl(
     k_list = _valid_k(cfg.k_num, n)
     if n < 4 or not k_list:
         log.event("too_small", n_cells=n)
-        return _single_cluster(n), None, None
+        return _single_cluster(n), None, None, None
     cfg = cfg.replace(k_num=k_list)
 
     # Sparse counts stay scipy CSR through size factors + HVG selection
@@ -524,12 +532,13 @@ def _level_impl(
             if chosen is not None:
                 cfg = cfg.replace(pc_num=chosen)
                 log.event("interactive_pc_num", pc_num=chosen)
+        pca_res = None
         if use_given_pca:
             pc_num = min(int(cfg.pc_num), ing.pca.shape[1])
             pca = np.asarray(ing.pca[:, :pc_num], np.float32)
         else:
             try:
-                scores, pc_num, _ = pca_for_config(
+                scores, pc_num, pca_res = pca_for_config(
                     norm, cfg.pc_num, cfg.pc_var,
                     center=cfg.center, scale=cfg.scale,
                     key=cluster_key(key, "pca"),
@@ -544,10 +553,10 @@ def _level_impl(
                 pca = np.asarray(scores)
             except Exception as e:  # PCA failure => single cluster (:368-379)
                 log.event("pca_failed", error=str(e))
-                return _single_cluster(n), None, None
+                return _single_cluster(n), None, None, None
             if not np.all(np.isfinite(pca)):
                 log.event("pca_failed", error="non-finite scores")
-                return _single_cluster(n), None, None
+                return _single_cluster(n), None, None, None
         # Shape bucketing of the PC axis (SURVEY §7.3 item 2): pad to a multiple
         # of 4 with zero columns — inert for every distance/silhouette downstream
         # (exact), but subproblems with nearby elbow choices share jit caches.
@@ -565,6 +574,49 @@ def _level_impl(
                     axis=1,
                 )
         log.event("pca", pc_num=int(pc_num))
+
+    # --- serving capture (serve/, ISSUE 3) --------------------------------
+    # Depth-1 runs fitted from raw counts freeze the preprocessing a query
+    # cell needs (HVG subset, normalization rule, PCA components) and the
+    # reference embedding re-computed through that FROZEN path — the exact
+    # arrays serve/assign.py applies at request time, so reference and
+    # query geometry agree by construction. Cheap: two stats reductions and
+    # one [n, g_hvg] @ [g_hvg, d] projection.
+    fit_capture = None
+    if (
+        depth == 1
+        and counts_hvg is not None
+        and norm is not None
+        and not use_given_pca
+        and pca_res is not None
+    ):
+        from consensusclustr_tpu.linalg.pca import standardization_stats
+        from consensusclustr_tpu.serve.assign import embed_reference_counts
+
+        mu_fit, sigma_fit = standardization_stats(norm, cfg.center, cfg.scale)
+        loadings_fit = np.asarray(pca_res.loadings[:, : int(pc_num)], np.float32)
+        libsize_mean = float(np.mean(np.sum(counts_hvg, axis=1)))
+        libsize_mean = libsize_mean if libsize_mean > 0 else 1.0
+        fit_capture = {
+            "embedding": embed_reference_counts(
+                counts_hvg, np.asarray(mu_fit), np.asarray(sigma_fit),
+                loadings_fit, libsize_mean,
+            ),
+            "mu": np.asarray(mu_fit, np.float32),
+            "sigma": np.asarray(sigma_fit, np.float32),
+            "loadings": loadings_fit,
+            "libsize_mean": libsize_mean,
+            "pc_num": int(pc_num),
+            "n_genes_full": int(n_genes),
+            "hvg_indices": (
+                np.flatnonzero(np.asarray(hvg_mask)) if hvg_mask is not None else None
+            ),
+            "gene_names": (
+                np.asarray(ing.gene_names)[np.asarray(hvg_mask)]
+                if ing.gene_names is not None and hvg_mask is not None
+                else None
+            ),
+        }
 
     # --- consensus clustering (L5, :388-511) ------------------------------
     with maybe_span(log, "consensus"):
@@ -652,7 +704,7 @@ def _level_impl(
                 )
                 labels = _relabel(labels)
     log.event("level_done", depth=depth, n_clusters=len(set(labels.tolist())))
-    return labels, cons, pca
+    return labels, cons, pca, fit_capture
 
 
 _BUCKET_BASE = 64
@@ -730,7 +782,7 @@ def _iterate(
         sub_key = depth_key(key, depth + 1, ci)
         sub_log = log.child()
         try:
-            child, _, _ = _level(sub_key, sub_ing, sub_cfg, sub_log, depth + 1)
+            child, _, _, _ = _level(sub_key, sub_ing, sub_cfg, sub_log, depth + 1)
             child = child[:n_c]
             if len(set(child.tolist())) > 1:
                 child = _iterate(
@@ -795,7 +847,7 @@ def consensus_clust(
 
     with tracer.span("ingest"):
         ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
-    labels, cons, pca_used = _level(key, ing, cfg, log, depth=cfg.depth)
+    labels, cons, pca_used, fit_capture = _level(key, ing, cfg, log, depth=cfg.depth)
     n = len(labels)
 
     if cfg.iterate and len(set(labels.tolist())) > 1 and ing.counts is not None:
@@ -839,6 +891,38 @@ def consensus_clust(
             tree = hierarchy_table(labels)
             edges = hierarchy_edges(labels)
 
+        # serving state: attach per-cluster bootstrap stability (the mean
+        # pairwise-Rand self-agreement across boots, the diagonal of the
+        # merge layer's stability matrix) to the frozen preprocessing
+        # capture — assign_cells reports it as per-neighbour confidence.
+        fit = None
+        if fit_capture is not None:
+            from consensusclustr_tpu.serve.artifact import (
+                ReferenceFit,
+                leaf_label_table,
+            )
+
+            leaf = leaf_label_table(labels)
+            stability = np.ones(len(leaf), np.float32)
+            if cons is not None and cons.boot_labels is not None and len(leaf) > 1:
+                from consensusclustr_tpu.consensus.merge import stability_matrix
+
+                code_of = {s: i for i, s in enumerate(leaf)}
+                codes = np.asarray(
+                    [code_of[str(l)] for l in labels], np.int32
+                )
+                c_pad = max(cfg.max_clusters, 1 << (len(leaf) - 1).bit_length())
+                sm = np.asarray(
+                    stability_matrix(
+                        codes, np.asarray(cons.boot_labels, np.int32),
+                        c_pad, cfg.max_clusters,
+                    )
+                )
+                stability = np.clip(
+                    np.diagonal(sm)[: len(leaf)], 0.0, 1.0
+                ).astype(np.float32)
+            fit = ReferenceFit(stability=stability, **fit_capture)
+
     # --- run record (obs/): span tree + events + metrics snapshot ---------
     record_device_memory(tracer.metrics)
     run_record = RunRecord.from_tracer(
@@ -858,4 +942,34 @@ def consensus_clust(
         clustree_edges=edges,
         log=log,
         run_record=run_record,
+        fit=fit,
     )
+
+
+def export_reference(result: ClusterResult, path: str, *, config=None):
+    """Persist a fitted run as a servable reference bundle (serve/artifact).
+
+    ``result`` must come from a ``consensus_clust(counts=...)`` run (raw
+    counts are what the frozen serving normalization is derived from).
+    Returns the in-memory ReferenceArtifact; the bundle at ``path`` is a
+    directory of ``arrays.npz`` + ``manifest.json``, schema-versioned and
+    checksummed — ``load_reference``/``assign_cells`` refuse corrupted or
+    unknown-schema bundles loudly.
+    """
+    from consensusclustr_tpu.serve.artifact import export_reference as _export
+
+    return _export(result, path, config=config)
+
+
+def assign_cells(reference, counts, *, mode: str = "robust", **kwargs):
+    """Map query cells onto an exported reference (serve/assign).
+
+    ``reference``: a ReferenceArtifact or bundle path; ``counts``: raw query
+    counts [q, genes] over the full reference gene space or its HVG subset.
+    ``mode="granular"`` additionally returns labels at every hierarchy
+    level. One-shot path — for sustained traffic use
+    serve.service.AssignmentService (micro-batching, warm-up, backpressure).
+    """
+    from consensusclustr_tpu.serve.assign import assign_cells as _assign
+
+    return _assign(reference, counts, mode=mode, **kwargs)

@@ -84,6 +84,80 @@ def knn_points(
     return idx.astype(jnp.int32), jnp.sqrt(jnp.maximum(-neg, 0.0))
 
 
+@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))
+def knn_cross(
+    query: jax.Array,
+    ref: jax.Array,
+    k: int,
+    block: int = KNN_BLOCK,
+    compute_dtype: str = "float32",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN of each query row against a SEPARATE reference set.
+
+    query: [q, d], ref: [n_ref, d]. Returns (idx [q, k] int32 into ref,
+    dist [q, k] float32) sorted by increasing distance — the serving-side
+    twin of :func:`knn_points` (which searches a set against itself). Self
+    matches are NOT excluded: a query identical to a reference row finds it
+    at distance 0, which is exactly what reference mapping wants.
+
+    For n_ref > 2*block the reference streams in [block] column tiles with a
+    running top-k merge, so peak memory is O(q * (k + block)) instead of
+    O(q * n_ref).
+    """
+    q = jnp.asarray(query, jnp.float32)
+    r = jnp.asarray(ref, jnp.float32)
+    cd = jnp.dtype(compute_dtype)
+    nq, nr = q.shape[0], r.shape[0]
+    k_eff = min(k, nr)
+    q2 = jnp.sum(q * q, axis=1)
+    qc = q.astype(cd)
+
+    if nr <= 2 * block:
+        cross = jnp.einsum(
+            "id,jd->ij", qc, r.astype(cd), preferred_element_type=jnp.float32
+        )
+        d2 = q2[:, None] - 2.0 * cross + jnp.sum(r * r, axis=1)[None, :]
+        neg, idx = jax.lax.top_k(-jnp.maximum(d2, 0.0), k_eff)
+    else:
+        n_blocks = -(-nr // block)
+        n_pad = n_blocks * block
+        r_pad = jnp.zeros((n_pad, r.shape[1]), cd).at[:nr].set(r.astype(cd))
+        # padded reference rows carry +inf norms so they can never be chosen
+        r2_pad = jnp.full((n_pad,), jnp.inf, jnp.float32).at[:nr].set(
+            jnp.sum(r * r, axis=1)
+        )
+        cols_local = jnp.arange(block, dtype=jnp.int32)
+
+        def step(carry, b):
+            best_neg, best_idx = carry
+            rb = jax.lax.dynamic_slice(r_pad, (b * block, 0), (block, r.shape[1]))
+            r2b = jax.lax.dynamic_slice(r2_pad, (b * block,), (block,))
+            cross = jnp.einsum(
+                "id,jd->ij", qc, rb, preferred_element_type=jnp.float32
+            )
+            d2 = q2[:, None] - 2.0 * cross + r2b[None, :]          # [q, block]
+            d2 = jnp.where(jnp.isfinite(d2), jnp.maximum(d2, 0.0), jnp.inf)
+            cand_neg = jnp.concatenate([best_neg, -d2], axis=1)
+            cols = jnp.broadcast_to((b * block + cols_local)[None, :], (nq, block))
+            cand_idx = jnp.concatenate([best_idx, cols], axis=1)
+            neg, sel = jax.lax.top_k(cand_neg, k_eff)
+            return (neg, jnp.take_along_axis(cand_idx, sel, axis=1)), None
+
+        init = (
+            jnp.full((nq, k_eff), -jnp.inf, jnp.float32),
+            jnp.zeros((nq, k_eff), jnp.int32),
+        )
+        (neg, idx), _ = jax.lax.scan(
+            step, init, jnp.arange(n_blocks, dtype=jnp.int32)
+        )
+
+    if k_eff < k:  # degenerate tiny references: pad with the last neighbour
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        neg = jnp.concatenate([neg, jnp.repeat(neg[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), jnp.sqrt(jnp.maximum(-neg, 0.0))
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def knn_from_distance(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN given a precomputed [n, n] distance matrix (the consensus

@@ -146,6 +146,14 @@ class ClusterConfig:
     # pipeline falls back to single-chip (with a log event) when a level's
     # shape can't (nboots<=1, or n not divisible by the mesh's cell axis).
     mesh: Optional[object] = None
+    # --- serving knobs (serve/, no reference counterpart) -------------------
+    # Resolution order everywhere: explicit AssignmentService argument >
+    # these fields > CCTPU_SERVE_QUEUE_DEPTH / CCTPU_SERVE_MAX_BATCH /
+    # CCTPU_SERVE_BUCKETS env vars > defaults (64 / 256 / powers of two).
+    # Defaults and rationale: docs/quirks.md "Serving defaults".
+    serve_queue_depth: Optional[int] = None   # bounded request-queue slots
+    serve_max_batch: Optional[int] = None     # max rows per micro-batch
+    serve_buckets: Optional[Sequence[int]] = None  # compiled pad-to sizes
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -181,6 +189,17 @@ class ClusterConfig:
             raise ValueError(
                 f"pipeline_depth must be >= 1 (1 = serial); got {self.pipeline_depth}"
             )
+        for knob in ("serve_queue_depth", "serve_max_batch"):
+            v = getattr(self, knob)
+            if v is not None and int(v) < 1:
+                raise ValueError(f"{knob} must be >= 1; got {v}")
+        if self.serve_buckets is not None:
+            sb = [int(b) for b in self.serve_buckets]
+            if not sb or any(b < 1 for b in sb):
+                raise ValueError(
+                    f"serve_buckets must be non-empty positive sizes; got "
+                    f"{self.serve_buckets!r}"
+                )
 
     def replace(self, **kw) -> "ClusterConfig":
         return dataclasses.replace(self, **kw)

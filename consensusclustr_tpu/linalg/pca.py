@@ -93,6 +93,31 @@ def truncated_pca(
     return PCAResult(scores=scores, sdev=sdev, loadings=vt[:k].T)
 
 
+@functools.partial(jax.jit, static_argnames=("center", "scale"))
+def standardization_stats(
+    x: jax.Array, center: bool = True, scale: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """(mu, sigma) of the implicit standardization ``A = (x - mu) / sigma``
+    that :func:`truncated_pca` applies — the frozen statistics a serving-time
+    projection (serve/assign.py) needs to place NEW rows into the same PC
+    space as the fitted loadings. Matches ``_stats`` exactly (ddof=1 sd,
+    near-zero sigmas clamped to 1)."""
+    x = jnp.asarray(x, jnp.float32)
+    return _stats(x, center, scale)
+
+
+def project_onto_loadings(
+    x: jax.Array, loadings: jax.Array, mu: jax.Array, sigma: jax.Array
+) -> jax.Array:
+    """Scores of new rows under a fitted PCA: ``((x - mu) / sigma) @ V``.
+
+    For the fitted matrix itself this reproduces ``PCAResult.scores``
+    (U S = A V); for unseen rows it is the out-of-sample projection used by
+    reference mapping."""
+    x = jnp.asarray(x, jnp.float32)
+    return ((x - mu[None, :]) / sigma[None, :]) @ loadings
+
+
 def choose_pc_num(sdev50: jax.Array, pc_var: float = 0.2, floor: int = 5) -> int:
     """Elbow rule (reference :356): smallest k with
     cumsum(sdev[1:k]) / sum(sdev[1:50]) > pc_var, floored at 5."""

@@ -49,6 +49,9 @@ EVENT_KINDS = frozenset({
     "split_retest",
     # utils/profiling.py
     "phase",
+    # serve/service.py
+    "serve_start",
+    "serve_drain",
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -74,6 +77,8 @@ SPAN_NAMES = frozenset({
     "null_test",
     "null_sims",        # one pipelined chunk loop (per adaptive round)
     "null_sim_chunk",
+    # serve/service.py
+    "serve_warmup",     # bucket-ladder compile pass at service load
 })
 
 # Metrics registry names (counters, gauges, histograms).
@@ -91,4 +96,11 @@ METRIC_NAMES = frozenset({
     "boot_chunk_seconds",       # histogram: dispatch->fetch latency per computed boot chunk
     "inflight_chunks",          # gauge: high-water mark of concurrently in-flight pipelined chunks
     "chunk_overlap_seconds",    # histogram: per chunk, seconds between dispatch and the host blocking on its fetch
+    # serve/ — the online assignment subsystem
+    "serve_latency_seconds",    # histogram: submit -> result per request
+    "queue_depth",              # gauge: request-queue occupancy at last submit/dequeue
+    "batch_occupancy",          # gauge: rows/bucket fill of the last micro-batch
+    "serve_compile",            # counter: bucket-shape first dispatches (XLA compiles)
+    "serve_rejections",         # counter: queue-full backpressure rejections
+    "compile_cache_enable_calls",  # counter: enable_persistent_cache invocations (idempotency telemetry)
 })

@@ -1,0 +1,56 @@
+"""Online reference-mapping service (ISSUE 3 tentpole).
+
+The offline pipeline (api.consensus_clust) fits a consensus clustering once;
+this package makes that fit a *servable model*: persist it as a versioned
+artifact, then assign new cells against it at request time without re-running
+any clustering — the query-to-reference mapping pattern of Seurat
+v4/Azimuth (Hao et al. 2021) and scArches (Lotfollahi et al. 2022), with
+TPU-shaped serving mechanics.
+
+Three layers, lowest first:
+
+  * ``artifact``  — ``ReferenceArtifact``: a schema-versioned, checksummed
+    bundle (npz arrays + json manifest) freezing everything a query needs:
+    HVG indices, normalization constants, PCA components, the reference
+    embedding, per-level consensus labels and per-cluster stability.
+    Import-light and jax-free: loading/validating an artifact never touches
+    a backend.
+  * ``assign``    — the jit-compiled query path: raw counts → frozen
+    normalization → PC projection (linalg/pca.py components) → blockwise
+    kNN vote against the reference embedding (cluster/knn.py) → label +
+    confidence. Batches pad to power-of-two buckets so XLA executables are
+    reused across request sizes.
+  * ``service``   — ``AssignmentService``: bounded request queue,
+    micro-batching, warm-up compiles at load, backpressure (queue-full →
+    ``RetryableRejection``), graceful drain, and obs/ metrics
+    (``serve_latency_seconds``, ``queue_depth``, ``batch_occupancy``,
+    ``serve_compile``).
+
+Top-level surface: ``api.export_reference(result, path)`` /
+``api.assign_cells(reference, counts)``; ``tools/serve_demo.py`` is the
+export-then-query driver.
+"""
+
+from consensusclustr_tpu.serve.artifact import (
+    ArtifactChecksumError,
+    ArtifactError,
+    ArtifactSchemaError,
+    ReferenceArtifact,
+    ReferenceFit,
+    SERVE_SCHEMA_VERSION,
+    export_reference,
+    load_reference,
+    reference_from_result,
+)
+
+__all__ = [
+    "ArtifactChecksumError",
+    "ArtifactError",
+    "ArtifactSchemaError",
+    "ReferenceArtifact",
+    "ReferenceFit",
+    "SERVE_SCHEMA_VERSION",
+    "export_reference",
+    "load_reference",
+    "reference_from_result",
+]

@@ -1,0 +1,328 @@
+"""Versioned reference-model artifacts: export a fitted consensus clustering
+as a servable bundle.
+
+A ``ReferenceArtifact`` freezes the minimal state a query cell needs to be
+mapped onto a fitted reference (the Azimuth/scArches "frozen reference"
+contract): the HVG gene subset, the serving normalization constants, the PCA
+components with their centring/scaling statistics, the reference cell×PC
+embedding, per-level consensus labels, and per-cluster bootstrap stability.
+
+On disk a bundle is a directory of two files:
+
+    <path>/arrays.npz      every array, saved uncompressed (bit-exact round trip)
+    <path>/manifest.json   schema version, sha256 of arrays.npz, label tables,
+                           shape summary, config fingerprint
+
+Loading fails LOUDLY on an unknown schema version (``ArtifactSchemaError``)
+or a checksum mismatch (``ArtifactChecksumError``) — a serving process must
+never silently assign against a half-written or incompatible model.
+
+Frozen-normalization semantics (documented deviation from the offline fit):
+the offline pipeline computes *deconvolution* size factors, which need the
+whole cohort; a query cell arrives alone. Serving therefore freezes the
+library-size ratio rule ``sf = rowsum(counts_hvg) / libsize_mean`` (the
+reference cohort's mean HVG library size), and ``export`` re-embeds the
+reference's own cells through that exact frozen path — so reference and
+query geometry agree by construction, and a reference cell re-submitted as a
+query lands on (numerically at) its own stored embedding point. Labels are
+never recomputed; they are the offline consensus assignments.
+
+This module is jax-free at import: artifact IO runs anywhere (report hosts,
+CI) without touching a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+SERVE_SCHEMA_VERSION = 1
+KNOWN_SCHEMAS = (1,)
+
+_ARRAYS = "arrays.npz"
+_MANIFEST = "manifest.json"
+
+
+class ArtifactError(RuntimeError):
+    """Base class for artifact load/export failures."""
+
+
+class ArtifactSchemaError(ArtifactError):
+    """Manifest declares a schema version this build does not understand."""
+
+
+class ArtifactChecksumError(ArtifactError):
+    """Stored arrays do not match the manifest checksum (corruption/tamper)."""
+
+
+def leaf_label_table(labels: np.ndarray) -> List[str]:
+    """Sorted unique label strings — THE canonical leaf-cluster order.
+
+    Every stability/score array aligned to leaf clusters (api capture,
+    artifact arrays, assign results) uses this order; sharing the helper is
+    what keeps them aligned.
+    """
+    return sorted({str(l) for l in np.asarray(labels).tolist()})
+
+
+def level_tables(labels: np.ndarray) -> Tuple[np.ndarray, List[List[str]]]:
+    """Per-level label codes from lineage strings ("2", "2_1", "2_1_3", ...).
+
+    Level ℓ truncates each label to its first ℓ underscore-separated parts; a
+    cell whose lineage is shallower than ℓ keeps its full label (its cluster
+    simply never split further). Level L (the deepest) therefore reproduces
+    the full assignment strings. Returns (codes [L, n] int32, one sorted
+    string table per level).
+    """
+    labels = [str(l) for l in np.asarray(labels).tolist()]
+    parts = [l.split("_") for l in labels]
+    n_levels = max(len(p) for p in parts)
+    codes = np.empty((n_levels, len(labels)), np.int32)
+    tables: List[List[str]] = []
+    for lvl in range(1, n_levels + 1):
+        strs = ["_".join(p[: min(lvl, len(p))]) for p in parts]
+        table = sorted(set(strs))
+        code_of = {s: i for i, s in enumerate(table)}
+        codes[lvl - 1] = [code_of[s] for s in strs]
+        tables.append(table)
+    return codes, tables
+
+
+@dataclasses.dataclass
+class ReferenceFit:
+    """In-memory serving state captured by api.consensus_clust (depth 1).
+
+    ``embedding`` is the reference re-embedded through the FROZEN serving
+    path (libsize-ratio size factors → log1p → standardize → project), not
+    the offline PCA scores — see the module docstring. Arrays are numpy,
+    host-side, small (no counts retained).
+    """
+
+    embedding: np.ndarray             # [n, d] float32, frozen-path embedding
+    mu: np.ndarray                    # [g_hvg] PCA centring vector
+    sigma: np.ndarray                 # [g_hvg] PCA scaling vector
+    loadings: np.ndarray              # [g_hvg, d] PCA components
+    libsize_mean: float               # mean reference HVG library size
+    pc_num: int
+    hvg_indices: Optional[np.ndarray] = None   # int64 into the full gene space
+    gene_names: Optional[np.ndarray] = None    # HVG-subset gene names
+    stability: Optional[np.ndarray] = None     # [C_leaf] per-cluster bootstrap
+    #                                            stability, leaf_label_table order
+    n_genes_full: Optional[int] = None         # width of the full gene space
+
+
+@dataclasses.dataclass
+class ReferenceArtifact:
+    """A loaded (or about-to-be-saved) reference model."""
+
+    embedding: np.ndarray             # [n, d] float32
+    mu: np.ndarray                    # [g] float32
+    sigma: np.ndarray                 # [g] float32
+    loadings: np.ndarray              # [g, d] float32
+    libsize_mean: float
+    level_codes: np.ndarray           # [L, n] int32
+    level_tables: List[List[str]]     # one sorted string table per level
+    stability: np.ndarray             # [C_leaf] float32, leaf-table order
+    pc_num: int
+    hvg_indices: Optional[np.ndarray] = None
+    gene_names: Optional[np.ndarray] = None
+    n_genes_full: Optional[int] = None
+    manifest: dict = dataclasses.field(default_factory=dict)
+
+    # -- shape views ---------------------------------------------------------
+
+    @property
+    def n_cells(self) -> int:
+        return int(self.embedding.shape[0])
+
+    @property
+    def n_hvg(self) -> int:
+        return int(self.mu.shape[0])
+
+    @property
+    def n_levels(self) -> int:
+        return int(self.level_codes.shape[0])
+
+    @property
+    def leaf_codes(self) -> np.ndarray:
+        return self.level_codes[-1]
+
+    @property
+    def leaf_table(self) -> List[str]:
+        return self.level_tables[-1]
+
+    def labels(self, level: Optional[int] = None) -> np.ndarray:
+        """Reference label strings at ``level`` (1-based; default = leaf)."""
+        lvl = self.n_levels if level is None else int(level)
+        if not (1 <= lvl <= self.n_levels):
+            raise ValueError(f"level must be in [1, {self.n_levels}]; got {lvl}")
+        table = np.asarray(self.level_tables[lvl - 1], dtype=object)
+        return table[self.level_codes[lvl - 1]]
+
+    # -- persistence ---------------------------------------------------------
+
+    def _array_payload(self) -> dict:
+        payload = {
+            "embedding": np.asarray(self.embedding, np.float32),
+            "mu": np.asarray(self.mu, np.float32),
+            "sigma": np.asarray(self.sigma, np.float32),
+            "loadings": np.asarray(self.loadings, np.float32),
+            "libsize_mean": np.asarray(self.libsize_mean, np.float32),
+            "level_codes": np.asarray(self.level_codes, np.int32),
+            "stability": np.asarray(self.stability, np.float32),
+            "pc_num": np.asarray(self.pc_num, np.int32),
+        }
+        if self.hvg_indices is not None:
+            payload["hvg_indices"] = np.asarray(self.hvg_indices, np.int64)
+        if self.gene_names is not None:
+            payload["gene_names"] = np.asarray(self.gene_names, np.str_)
+        if self.n_genes_full is not None:
+            payload["n_genes_full"] = np.asarray(self.n_genes_full, np.int64)
+        return payload
+
+    def save(self, path: str, config: Any = None) -> str:
+        """Write the bundle directory; returns ``path``.
+
+        Files land atomically (tmp + os.replace) so a crashed export never
+        leaves a loadable-looking half bundle: the manifest — written LAST —
+        is what load() requires first.
+        """
+        os.makedirs(path, exist_ok=True)
+        arrays_path = os.path.join(path, _ARRAYS)
+        tmp = arrays_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **self._array_payload())
+        os.replace(tmp, arrays_path)
+        with open(arrays_path, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+
+        # config snapshot/fingerprint via obs.record (jax-free module)
+        fingerprint = snapshot = None
+        if config is not None:
+            from consensusclustr_tpu.obs.record import (
+                _config_dict,
+                config_fingerprint,
+            )
+
+            fingerprint = config_fingerprint(config)
+            snapshot = _config_dict(config)
+
+        manifest = {
+            "schema": SERVE_SCHEMA_VERSION,
+            "checksum_sha256": digest,
+            "n_cells": self.n_cells,
+            "n_hvg": self.n_hvg,
+            "pc_num": int(self.pc_num),
+            "n_levels": self.n_levels,
+            "n_leaf_clusters": len(self.leaf_table),
+            "level_tables": self.level_tables,
+            "libsize_mean": float(self.libsize_mean),
+            "created_unix": time.time(),
+            "config_fingerprint": fingerprint,
+            "config": snapshot,
+        }
+        tmp = os.path.join(path, _MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(path, _MANIFEST))
+        self.manifest = manifest
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ReferenceArtifact":
+        """Validate and load a bundle; fails loudly on schema/checksum."""
+        manifest_path = os.path.join(path, _MANIFEST)
+        arrays_path = os.path.join(path, _ARRAYS)
+        if not os.path.isfile(manifest_path):
+            raise ArtifactError(f"{path}: no {_MANIFEST} (not a reference bundle)")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        schema = manifest.get("schema")
+        if schema not in KNOWN_SCHEMAS:
+            raise ArtifactSchemaError(
+                f"{path}: artifact schema {schema!r} not supported "
+                f"(this build knows {KNOWN_SCHEMAS}); re-export the reference"
+            )
+        with open(arrays_path, "rb") as f:
+            blob = f.read()
+        digest = hashlib.sha256(blob).hexdigest()
+        expected = manifest.get("checksum_sha256")
+        if digest != expected:
+            raise ArtifactChecksumError(
+                f"{path}: {_ARRAYS} sha256 {digest[:12]}… does not match "
+                f"manifest {str(expected)[:12]}… — bundle is corrupted or was "
+                "modified after export"
+            )
+        import io
+
+        with np.load(io.BytesIO(blob)) as z:
+            arrays = {k: z[k] for k in z.files}
+        return cls(
+            embedding=arrays["embedding"],
+            mu=arrays["mu"],
+            sigma=arrays["sigma"],
+            loadings=arrays["loadings"],
+            libsize_mean=float(arrays["libsize_mean"]),
+            level_codes=arrays["level_codes"],
+            level_tables=[list(t) for t in manifest["level_tables"]],
+            stability=arrays["stability"],
+            pc_num=int(arrays["pc_num"]),
+            hvg_indices=arrays.get("hvg_indices"),
+            gene_names=arrays.get("gene_names"),
+            n_genes_full=(
+                int(arrays["n_genes_full"]) if "n_genes_full" in arrays else None
+            ),
+            manifest=manifest,
+        )
+
+
+def reference_from_result(result: Any, config: Any = None) -> ReferenceArtifact:
+    """Build a ReferenceArtifact from a ClusterResult carrying serving state.
+
+    The fit state (``result.fit``) is captured by ``consensus_clust`` when
+    the run had raw counts to freeze a normalization from; pca-only or
+    norm-counts-only runs cannot be served and fail here with instructions.
+    """
+    fit = getattr(result, "fit", None)
+    if fit is None:
+        raise ArtifactError(
+            "this ClusterResult carries no serving state — export needs a run "
+            "fitted from raw counts (consensus_clust(counts=...)); pca= / "
+            "norm_counts=-only inputs have no normalization to freeze"
+        )
+    labels = np.asarray(result.assignments)
+    codes, tables = level_tables(labels)
+    stability = fit.stability
+    if stability is None:
+        stability = np.ones(len(tables[-1]), np.float32)
+    return ReferenceArtifact(
+        embedding=fit.embedding,
+        mu=fit.mu,
+        sigma=fit.sigma,
+        loadings=fit.loadings,
+        libsize_mean=float(fit.libsize_mean),
+        level_codes=codes,
+        level_tables=tables,
+        stability=np.asarray(stability, np.float32),
+        pc_num=int(fit.pc_num),
+        hvg_indices=fit.hvg_indices,
+        gene_names=fit.gene_names,
+        n_genes_full=fit.n_genes_full,
+    )
+
+
+def export_reference(result: Any, path: str, config: Any = None) -> ReferenceArtifact:
+    """ClusterResult → saved bundle at ``path``. Returns the artifact."""
+    art = reference_from_result(result, config=config)
+    art.save(path, config=config)
+    return art
+
+
+def load_reference(path: str) -> ReferenceArtifact:
+    return ReferenceArtifact.load(path)

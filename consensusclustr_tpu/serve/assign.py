@@ -1,0 +1,367 @@
+"""The jit-compiled query path: raw counts → frozen normalization → PC
+projection → blockwise kNN vote against the reference embedding.
+
+Pipeline per micro-batch (all one jitted program per bucket shape):
+
+  1. frozen normalization — ``sf = rowsum(counts_hvg) / libsize_mean`` (the
+     artifact's frozen library-size rule; all-zero rows get sf 1), then
+     ``log1p(x / sf)``: the serving twin of prep/transform.shifted_log;
+  2. projection into reference PC space via the fitted loadings and their
+     centring/scaling stats (linalg/pca.project_onto_loadings);
+  3. exact blockwise kNN against the reference embedding
+     (cluster/knn.knn_cross) and a per-class vote over the k neighbours'
+     leaf labels: label = majority class, confidence = vote fraction,
+     plus the mean bootstrap stability of the winning neighbours;
+  4. exact-match snap: a query that lands (numerically) ON a reference cell
+     — squared distance ≤ ``snap_eps * (1 + |q|²)`` — inherits that cell's
+     label with confidence 1. This is what makes self-assignment reproduce
+     the offline consensus labels bit-for-bit at every bucket size: an
+     identical cell IS that cell, and no k-neighbour majority in a boundary
+     region may overrule it.
+
+Batches pad to power-of-two bucket shapes (``resolve_buckets``) so XLA
+compiles one executable per bucket, not per request size; padded rows are
+masked out host-side. Granular mode votes once at the LEAF level and reports
+each level as the winner's lineage prefix — per-level majorities could
+disagree with their own parent, a hierarchy no consumer wants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensusclustr_tpu.serve.artifact import ReferenceArtifact
+
+DEFAULT_MAX_BATCH = 256
+DEFAULT_K = 15
+# Relative squared-distance threshold for the exact-match snap. A self-query
+# differs from its stored embedding only by f32 matmul reassociation across
+# batch shapes (≲1e-6 relative), while distinct cells in PC space sit O(1)+
+# apart; 1e-4 relative leaves orders of magnitude on both sides.
+DEFAULT_SNAP_EPS = 1e-4
+
+
+def resolve_max_batch(requested: Optional[int] = None) -> int:
+    """Explicit arg > $CCTPU_SERVE_MAX_BATCH > 256 (see docs/quirks.md)."""
+    if requested is None:
+        requested = int(os.environ.get("CCTPU_SERVE_MAX_BATCH", DEFAULT_MAX_BATCH))
+    v = int(requested)
+    if v < 1:
+        raise ValueError(f"serve_max_batch must be >= 1; got {v}")
+    return v
+
+
+def resolve_buckets(
+    requested=None, max_batch: Optional[int] = None
+) -> Tuple[int, ...]:
+    """The compiled bucket ladder: explicit sizes > $CCTPU_SERVE_BUCKETS
+    (comma-separated) > powers of two 1..max_batch. Always sorted, deduped,
+    and capped so the largest bucket can hold a full micro-batch."""
+    if requested is None:
+        env = os.environ.get("CCTPU_SERVE_BUCKETS")
+        if env:
+            requested = [int(s) for s in env.split(",") if s.strip()]
+    mb = resolve_max_batch(max_batch)
+    if requested is None:
+        sizes = []
+        b = 1
+        while b < mb:
+            sizes.append(b)
+            b *= 2
+        sizes.append(mb)
+    else:
+        sizes = [int(b) for b in requested]
+        if any(b < 1 for b in sizes):
+            raise ValueError(f"bucket sizes must be >= 1; got {sizes}")
+        if max(sizes) < mb:
+            sizes.append(mb)
+    return tuple(sorted(set(sizes)))
+
+
+def bucket_for(n_rows: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= n_rows (callers cap n_rows at max(buckets))."""
+    for b in buckets:
+        if b >= n_rows:
+            return b
+    raise ValueError(f"batch of {n_rows} rows exceeds largest bucket {buckets[-1]}")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_classes"))
+def _assign_batch(
+    counts,       # [q, g] float32 raw HVG counts (padded rows all-zero)
+    ref_emb,      # [n_ref, d] float32
+    ref_codes,    # [n_ref] int32 leaf cluster codes
+    stability,    # [n_classes] float32 per-cluster bootstrap stability
+    mu,           # [g]
+    sigma,        # [g]
+    loadings,     # [g, d]
+    libsize_mean, # scalar
+    snap_eps,     # scalar
+    k: int,
+    n_classes: int,
+):
+    """One bucket-shaped micro-batch end to end on device."""
+    from consensusclustr_tpu.cluster.knn import knn_cross
+    from consensusclustr_tpu.linalg.pca import project_onto_loadings
+
+    x = jnp.asarray(counts, jnp.float32)
+    lib = jnp.sum(x, axis=1)
+    sf = jnp.where(lib > 0, lib / jnp.maximum(libsize_mean, 1e-12), 1.0)
+    norm = jnp.log1p(x / sf[:, None])
+    proj = project_onto_loadings(norm, loadings, mu, sigma)     # [q, d]
+
+    k_eff = min(k, ref_emb.shape[0])
+    idx, dist = knn_cross(proj, ref_emb, k_eff)                 # [q, k_eff]
+    codes_nb = ref_codes[idx]                                   # [q, k_eff]
+
+    onehot = (codes_nb[:, :, None] == jnp.arange(n_classes)[None, None, :])
+    votes = jnp.sum(onehot.astype(jnp.float32), axis=1)         # [q, C]
+    winner = jnp.argmax(votes, axis=1).astype(jnp.int32)
+    frac = jnp.take_along_axis(votes, winner[:, None], axis=1)[:, 0] / k_eff
+
+    stab_nb = stability[codes_nb]                               # [q, k_eff]
+    win_mask = (codes_nb == winner[:, None]).astype(jnp.float32)
+    mean_stab = jnp.sum(stab_nb * win_mask, axis=1) / jnp.maximum(
+        jnp.sum(win_mask, axis=1), 1.0
+    )
+
+    # exact-match snap (see module docstring)
+    q2 = jnp.sum(proj * proj, axis=1)
+    d2_min = dist[:, 0] ** 2
+    nearest = ref_codes[idx[:, 0]]
+    snap = d2_min <= snap_eps * (1.0 + q2)
+    winner = jnp.where(snap, nearest, winner)
+    frac = jnp.where(snap, 1.0, frac)
+    mean_stab = jnp.where(snap, stability[nearest], mean_stab)
+    return winner, frac, mean_stab, dist[:, 0]
+
+
+@dataclasses.dataclass
+class AssignResult:
+    """Per-query labels + confidence from one assign call.
+
+    ``labels`` are leaf (full-lineage) strings; ``levels`` (granular mode
+    only) maps level ℓ (1-based) to that level's label strings — level ℓ of
+    a query is the first ℓ lineage parts of its leaf label.
+    """
+
+    labels: np.ndarray                # [q] str leaf labels
+    confidence: np.ndarray            # [q] float32 vote fraction (1.0 = snap)
+    neighbor_stability: np.ndarray    # [q] float32 mean winning-neighbour stability
+    nearest_distance: np.ndarray      # [q] float32 distance to nearest ref cell
+    levels: Optional[Dict[int, np.ndarray]] = None  # granular mode only
+
+
+class CompileTracker:
+    """Host-side record of which (bucket, genes) shapes have been dispatched.
+
+    XLA exposes no per-call compile hook, but the dispatch pattern is fully
+    ours: a bucket shape's FIRST dispatch is its compile (jit caches by
+    shape). ``note`` increments the ``serve_compile`` counter exactly then.
+    """
+
+    def __init__(self) -> None:
+        self._seen: set = set()
+
+    def note(self, bucket: int, n_genes: int, metrics=None) -> bool:
+        key = (int(bucket), int(n_genes))
+        fresh = key not in self._seen
+        if fresh:
+            self._seen.add(key)
+            if metrics is not None:
+                metrics.counter("serve_compile").inc()
+        return fresh
+
+    @property
+    def count(self) -> int:
+        return len(self._seen)
+
+
+def subset_to_hvg(reference: ReferenceArtifact, counts: np.ndarray) -> np.ndarray:
+    """Query counts → the artifact's HVG gene space.
+
+    Accepts either the full gene space (subset by the stored hvg_indices) or
+    counts already in HVG space; anything else is a loud shape error.
+    """
+    counts = np.asarray(counts, np.float32)
+    if counts.ndim == 1:
+        counts = counts[None, :]
+    g = reference.n_hvg
+    if counts.shape[1] == g:
+        return counts
+    idx = reference.hvg_indices
+    full = reference.n_genes_full
+    if idx is not None:
+        # exact full-space width when the artifact recorded it; otherwise
+        # (hand-built artifacts) any width that covers every HVG index
+        if (full is not None and counts.shape[1] == full) or (
+            full is None and counts.shape[1] > int(idx.max())
+        ):
+            return counts[:, idx]
+    raise ValueError(
+        f"query counts have {counts.shape[1]} genes; the reference expects "
+        f"{g} HVG genes"
+        + (
+            f" or the full {full}-gene space"
+            if idx is not None and full is not None
+            else ""
+        )
+        + (
+            " (artifact stores no hvg_indices, so full-space input cannot "
+            "be subset)"
+            if idx is None
+            else ""
+        )
+    )
+
+
+def _device_state(reference: ReferenceArtifact):
+    """Upload the artifact's arrays once per process (keyed on identity)."""
+    cached = getattr(reference, "_device_state", None)
+    if cached is None:
+        cached = (
+            jnp.asarray(reference.embedding, jnp.float32),
+            jnp.asarray(reference.leaf_codes, jnp.int32),
+            jnp.asarray(reference.stability, jnp.float32),
+            jnp.asarray(reference.mu, jnp.float32),
+            jnp.asarray(reference.sigma, jnp.float32),
+            jnp.asarray(reference.loadings, jnp.float32),
+            jnp.float32(reference.libsize_mean),
+        )
+        # dataclass without __slots__: cache lives with the artifact object
+        reference._device_state = cached
+    return cached
+
+
+def assign_bucketed(
+    reference: ReferenceArtifact,
+    counts_hvg: np.ndarray,
+    *,
+    k: int = DEFAULT_K,
+    buckets: Optional[Tuple[int, ...]] = None,
+    max_batch: Optional[int] = None,
+    snap_eps: float = DEFAULT_SNAP_EPS,
+    metrics=None,
+    compile_tracker: Optional[CompileTracker] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Codes + confidence for counts already in HVG space, bucket-padded.
+
+    Splits the queries into micro-batches of at most ``max(buckets)`` rows,
+    pads each to its bucket with all-zero rows (masked off after), and runs
+    one jitted program per bucket shape. Returns (codes [q] int32,
+    confidence [q], neighbor_stability [q], nearest_distance [q]).
+    """
+    buckets = resolve_buckets(buckets, max_batch)
+    ref_emb, ref_codes, stability, mu, sigma, loadings, lsm = _device_state(
+        reference
+    )
+    n_classes = len(reference.leaf_table)
+    q_total = counts_hvg.shape[0]
+    out = [np.empty(q_total, dt) for dt in (np.int32, np.float32, np.float32, np.float32)]
+    step = buckets[-1]
+    for s in range(0, q_total, step):
+        chunk = counts_hvg[s : s + step]
+        b = bucket_for(chunk.shape[0], buckets)
+        if compile_tracker is not None:
+            compile_tracker.note(b, chunk.shape[1], metrics)
+        padded = chunk
+        if b != chunk.shape[0]:
+            padded = np.zeros((b, chunk.shape[1]), np.float32)
+            padded[: chunk.shape[0]] = chunk
+        codes, frac, stab, dist = _assign_batch(
+            padded, ref_emb, ref_codes, stability, mu, sigma, loadings, lsm,
+            np.float32(snap_eps), k=k, n_classes=n_classes,
+        )
+        n = chunk.shape[0]
+        for buf, dev in zip(out, (codes, frac, stab, dist)):
+            buf[s : s + n] = np.asarray(dev)[:n]
+    return tuple(out)  # type: ignore[return-value]
+
+
+def _labels_from_codes(
+    reference: ReferenceArtifact, codes: np.ndarray, granular: bool
+) -> Tuple[np.ndarray, Optional[Dict[int, np.ndarray]]]:
+    leaf_table = np.asarray(reference.leaf_table, dtype=object)
+    labels = leaf_table[codes]
+    if not granular:
+        return labels, None
+    levels: Dict[int, np.ndarray] = {}
+    for lvl in range(1, reference.n_levels + 1):
+        levels[lvl] = np.asarray(
+            ["_".join(str(l).split("_")[:lvl]) for l in labels], dtype=object
+        )
+    return labels, levels
+
+
+def assign_cells(
+    reference,
+    counts,
+    *,
+    mode: str = "robust",
+    k: int = DEFAULT_K,
+    buckets: Optional[Tuple[int, ...]] = None,
+    max_batch: Optional[int] = None,
+    snap_eps: float = DEFAULT_SNAP_EPS,
+    metrics=None,
+) -> AssignResult:
+    """One-shot query-to-reference mapping (no service/queue).
+
+    ``reference`` is a ReferenceArtifact or a bundle path; ``counts`` are raw
+    query counts over the full gene space or the HVG subset. ``mode`` follows
+    the offline vocabulary: "robust" returns leaf labels only, "granular"
+    additionally reports every hierarchy level. For sustained traffic use
+    serve.service.AssignmentService, which adds micro-batching across
+    requests, warm-up compiles and backpressure on top of this path.
+    """
+    from consensusclustr_tpu.serve.artifact import load_reference
+
+    if mode not in ("robust", "granular"):
+        raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
+    if isinstance(reference, (str, os.PathLike)):
+        reference = load_reference(os.fspath(reference))
+    counts_hvg = subset_to_hvg(reference, counts)
+    codes, frac, stab, dist = assign_bucketed(
+        reference, counts_hvg, k=k, buckets=buckets, max_batch=max_batch,
+        snap_eps=snap_eps, metrics=metrics,
+    )
+    labels, levels = _labels_from_codes(reference, codes, mode == "granular")
+    return AssignResult(
+        labels=labels,
+        confidence=frac,
+        neighbor_stability=stab,
+        nearest_distance=dist,
+        levels=levels,
+    )
+
+
+def embed_reference_counts(
+    counts_hvg: np.ndarray,
+    mu: np.ndarray,
+    sigma: np.ndarray,
+    loadings: np.ndarray,
+    libsize_mean: float,
+) -> np.ndarray:
+    """The export-side frozen embedding: reference cells through the EXACT
+    normalization + projection the query path applies (same functions, so
+    reference and query geometry agree by construction)."""
+    from consensusclustr_tpu.linalg.pca import project_onto_loadings
+
+    x = jnp.asarray(counts_hvg, jnp.float32)
+    lib = jnp.sum(x, axis=1)
+    sf = jnp.where(lib > 0, lib / jnp.maximum(libsize_mean, 1e-12), 1.0)
+    norm = jnp.log1p(x / sf[:, None])
+    return np.asarray(
+        project_onto_loadings(
+            norm,
+            jnp.asarray(loadings, jnp.float32),
+            jnp.asarray(mu, jnp.float32),
+            jnp.asarray(sigma, jnp.float32),
+        )
+    )

@@ -1,0 +1,358 @@
+"""AssignmentService: a bounded-queue, micro-batching front end for online
+cluster assignment.
+
+Serving mechanics (the TPU-shaped half of the ISSUE 3 tentpole):
+
+  * **bounded request queue** — ``serve_queue_depth`` slots; a full queue
+    rejects the submit with :class:`RetryableRejection` (retryable by
+    contract: the caller backs off and resubmits, nothing was enqueued).
+    Unbounded queues turn overload into unbounded latency; a bounded queue
+    turns it into explicit backpressure.
+  * **micro-batching** — one worker thread drains whole requests greedily up
+    to ``serve_max_batch`` rows and runs them as a single device program.
+    Batching amortises dispatch overhead; padding the batch to the next
+    power-of-two bucket (serve/assign.resolve_buckets) means XLA compiles
+    one executable per bucket, reused across every request size.
+  * **warm-up at load** — each bucket shape is dispatched once with zero
+    rows before traffic arrives (and the persistent XLA compile cache is
+    enabled first, utils/compile_cache), so no request ever pays a compile.
+  * **graceful drain** — ``close()`` stops intake, processes everything
+    already queued, then joins the worker; pending futures always resolve.
+
+Observability (names registered in obs/schema.py):
+
+  * ``serve_latency_seconds`` histogram — submit→result per request;
+  * ``queue_depth`` gauge — queue occupancy at the last submit/dequeue;
+  * ``batch_occupancy`` gauge — rows/bucket of the last micro-batch (how
+    much of each compiled shape real traffic fills);
+  * ``serve_compile`` counter — bucket-shape first dispatches (compiles);
+  * ``serve_rejections`` counter — backpressure rejections.
+
+Knob resolution follows the package's env-override pattern
+(parallel/pipelined.pipeline_depth): explicit argument >
+``ClusterConfig.serve_*`` field > ``CCTPU_SERVE_*`` env var > default.
+Defaults are documented in docs/quirks.md.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from consensusclustr_tpu.obs import RunRecord, Tracer
+from consensusclustr_tpu.serve.artifact import ReferenceArtifact
+from consensusclustr_tpu.serve.assign import (
+    AssignResult,
+    CompileTracker,
+    DEFAULT_K,
+    DEFAULT_SNAP_EPS,
+    _labels_from_codes,
+    assign_bucketed,
+    bucket_for,
+    resolve_buckets,
+    resolve_max_batch,
+    subset_to_hvg,
+)
+
+DEFAULT_QUEUE_DEPTH = 64
+
+_SENTINEL = None
+
+
+class RetryableRejection(RuntimeError):
+    """Queue-full backpressure: nothing was enqueued; back off and retry."""
+
+
+def serve_queue_depth(requested: Optional[int] = None) -> int:
+    """Explicit arg > $CCTPU_SERVE_QUEUE_DEPTH > 64 (see docs/quirks.md)."""
+    if requested is None:
+        requested = int(
+            os.environ.get("CCTPU_SERVE_QUEUE_DEPTH", DEFAULT_QUEUE_DEPTH)
+        )
+    v = int(requested)
+    if v < 1:
+        raise ValueError(f"serve_queue_depth must be >= 1; got {v}")
+    return v
+
+
+class _Request:
+    __slots__ = ("counts_hvg", "mode", "future", "t_submit", "rows")
+
+    def __init__(self, counts_hvg: np.ndarray, mode: str) -> None:
+        self.counts_hvg = counts_hvg
+        self.mode = mode
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.rows = int(counts_hvg.shape[0])
+
+
+class AssignmentService:
+    """Micro-batched online assignment against one ReferenceArtifact.
+
+    Usage::
+
+        with AssignmentService(artifact) as svc:
+            fut = svc.submit(query_counts)          # -> concurrent Future
+            result = fut.result()                   # AssignResult
+            result = svc.assign(query_counts)       # sync convenience
+
+    Thread model: submits may come from any thread; all device work runs on
+    the single worker thread (the package's host control is single-threaded
+    by design, SURVEY §7.1 — one worker keeps that true for serving too).
+    """
+
+    def __init__(
+        self,
+        reference: ReferenceArtifact,
+        *,
+        config=None,
+        queue_depth: Optional[int] = None,
+        max_batch: Optional[int] = None,
+        buckets: Optional[Sequence[int]] = None,
+        k: int = DEFAULT_K,
+        mode: str = "robust",
+        snap_eps: float = DEFAULT_SNAP_EPS,
+        warmup: bool = True,
+        start: bool = True,
+        tracer: Optional[Tracer] = None,
+    ) -> None:
+        if mode not in ("robust", "granular"):
+            raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
+        self.reference = reference
+        cfg = config
+        self.queue_depth = serve_queue_depth(
+            queue_depth
+            if queue_depth is not None
+            else getattr(cfg, "serve_queue_depth", None)
+        )
+        self.max_batch = resolve_max_batch(
+            max_batch
+            if max_batch is not None
+            else getattr(cfg, "serve_max_batch", None)
+        )
+        self.buckets: Tuple[int, ...] = resolve_buckets(
+            buckets
+            if buckets is not None
+            else getattr(cfg, "serve_buckets", None),
+            self.max_batch,
+        )
+        self.k = int(k)
+        self.mode = mode
+        self.snap_eps = float(snap_eps)
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.metrics = self.tracer.metrics
+        self._tracker = CompileTracker()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._thread: Optional[threading.Thread] = None
+        self._closing = False
+        self._closed = False
+        if warmup:
+            self.warmup()
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile every bucket shape before traffic arrives.
+
+        Calls utils/compile_cache.enable_persistent_cache unconditionally
+        (idempotent; ISSUE 3 satellite), then pushes one all-zero batch per
+        bucket through the real assign program.
+        """
+        from consensusclustr_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
+        g = self.reference.n_hvg
+        with self.tracer.span(
+            "serve_warmup", buckets=list(self.buckets), n_hvg=g
+        ) as sp:
+            for b in self.buckets:
+                codes, _, _, _ = assign_bucketed(
+                    self.reference,
+                    np.zeros((b, g), np.float32),
+                    k=self.k,
+                    buckets=(b,),
+                    snap_eps=self.snap_eps,
+                    metrics=self.metrics,
+                    compile_tracker=self._tracker,
+                )
+                assert codes.shape == (b,)
+            sp.set(compiles=self._tracker.count)
+
+    def start(self) -> None:
+        if self._closed:
+            raise RuntimeError("AssignmentService already closed")
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="cctpu-assign-service", daemon=True
+            )
+            self._thread.start()
+            self.tracer.event(
+                "serve_start",
+                queue_depth=self.queue_depth,
+                max_batch=self.max_batch,
+                buckets=list(self.buckets),
+            )
+
+    def close(self) -> None:
+        """Stop intake, drain everything queued, join the worker."""
+        if self._closed:
+            return
+        self._closing = True
+        if self._thread is not None:
+            self._queue.put(_SENTINEL)  # lands after all accepted requests
+            self._thread.join()
+            self._thread = None
+        else:
+            # never started: fail queued futures rather than strand callers
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not _SENTINEL:
+                    req.future.set_exception(
+                        RuntimeError("AssignmentService closed before start")
+                    )
+        self._closed = True
+        self.tracer.event("serve_drain")
+
+    def __enter__(self) -> "AssignmentService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, counts, mode: Optional[str] = None) -> Future:
+        """Enqueue one request; returns a Future of AssignResult.
+
+        Raises :class:`RetryableRejection` when the queue is full (nothing
+        enqueued — back off and retry) and ValueError for batches larger
+        than ``serve_max_batch`` (split them client-side).
+        """
+        if self._closing or self._closed:
+            raise RuntimeError("AssignmentService is shut down")
+        mode = self.mode if mode is None else mode
+        if mode not in ("robust", "granular"):
+            raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
+        counts_hvg = subset_to_hvg(self.reference, counts)
+        if counts_hvg.shape[0] > self.max_batch:
+            raise ValueError(
+                f"request of {counts_hvg.shape[0]} rows exceeds "
+                f"serve_max_batch={self.max_batch}; split it client-side"
+            )
+        req = _Request(counts_hvg, mode)
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.counter("serve_rejections").inc()
+            raise RetryableRejection(
+                f"queue full ({self.queue_depth} requests in flight); retry"
+            ) from None
+        self.metrics.gauge("queue_depth").set(self._queue.qsize())
+        return req.future
+
+    def assign(self, counts, mode: Optional[str] = None, timeout=None) -> AssignResult:
+        """Synchronous submit + wait."""
+        return self.submit(counts, mode=mode).result(timeout=timeout)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        from collections import deque
+
+        pending: "deque[_Request]" = deque()
+        drained = False
+        while True:
+            if not pending:
+                if drained:
+                    return
+                item = self._queue.get()
+                if item is _SENTINEL:
+                    return
+                pending.append(item)
+            # opportunistic non-blocking drain: batch whatever has piled up
+            while not drained:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _SENTINEL:
+                    drained = True
+                    break
+                pending.append(item)
+            self.metrics.gauge("queue_depth").set(self._queue.qsize())
+            batch, rows = [], 0
+            while pending and rows + pending[0].rows <= self.max_batch:
+                req = pending.popleft()
+                batch.append(req)
+                rows += req.rows
+            self._run_batch(batch, rows)
+
+    def _run_batch(self, batch, rows: int) -> None:
+        try:
+            bucket = bucket_for(rows, self.buckets)
+            self.metrics.gauge("batch_occupancy").set(rows / bucket)
+            counts = (
+                batch[0].counts_hvg
+                if len(batch) == 1
+                else np.concatenate([r.counts_hvg for r in batch], axis=0)
+            )
+            codes, frac, stab, dist = assign_bucketed(
+                self.reference, counts, k=self.k, buckets=self.buckets,
+                snap_eps=self.snap_eps, metrics=self.metrics,
+                compile_tracker=self._tracker,
+            )
+            t_done = time.perf_counter()
+            s = 0
+            for req in batch:
+                e = s + req.rows
+                labels, levels = _labels_from_codes(
+                    self.reference, codes[s:e], req.mode == "granular"
+                )
+                result = AssignResult(
+                    labels=labels,
+                    confidence=frac[s:e],
+                    neighbor_stability=stab[s:e],
+                    nearest_distance=dist[s:e],
+                    levels=levels,
+                )
+                self.metrics.histogram("serve_latency_seconds").observe(
+                    t_done - req.t_submit
+                )
+                req.future.set_result(result)
+                s = e
+        except BaseException as e:  # fail the whole batch, keep serving
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def bucket_compiles(self) -> int:
+        return self._tracker.count
+
+    def stats(self) -> dict:
+        return self.metrics.snapshot()
+
+    def run_record(self, config=None) -> RunRecord:
+        """Snapshot the service's spans/metrics as a RunRecord (for
+        tools/report.py's "== serving ==" table)."""
+        from consensusclustr_tpu.utils.backend import default_backend
+
+        return RunRecord.from_tracer(
+            self.tracer, config=config, backend=default_backend(),
+            include_global_metrics=False,
+        )

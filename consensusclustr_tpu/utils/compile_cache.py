@@ -7,6 +7,14 @@ every recursion level that repeats a shape—hit the cache across processes.
 
 Enabled by the top-level API on first use; opt out with CCTPU_NO_COMPILE_CACHE
 or redirect with CCTPU_COMPILE_CACHE_DIR.
+
+Idempotency contract (ISSUE 3 satellite): ``enable_persistent_cache`` may be
+called unconditionally from any entry point — the offline API, the serving
+warm-up path, bench — and only the FIRST call does configuration work; every
+call increments the ``compile_cache_enable_calls`` counter and the
+``compile_cache_enabled`` gauge reflects the resolved state (1 active,
+0 disabled: CPU backend, opt-out env, or setup failure) exactly once per
+process. The function returns that resolved state so callers can log it.
 """
 
 from __future__ import annotations
@@ -21,38 +29,47 @@ from consensusclustr_tpu.utils.backend import default_backend
 _done = False
 
 
-def enable_persistent_cache() -> None:
+def enable_persistent_cache() -> bool:
+    """Idempotently enable the on-disk XLA cache; True iff it is active."""
     global _done
+    mets = global_metrics()
+    mets.counter("compile_cache_enable_calls").inc()
     if _done or os.environ.get("CCTPU_NO_COMPILE_CACHE"):
-        return
+        if not _done:
+            # opted out: record the decision once so later (env-less) calls
+            # stay no-ops and records show the cache state explicitly
+            mets.gauge("compile_cache_enabled").set(0)
+            _done = True
+        return bool(mets.gauge("compile_cache_enabled").value)
     # XLA:CPU executable deserialization is unreliable (observed: SIGSEGV in
     # compilation_cache.get_executable_and_time on a cache hit written by the
     # SAME process's host, plus "machine features mismatch ... SIGILL"
     # warnings from the AOT loader). CPU compiles are cheap anyway — the
     # cache only pays for itself on accelerators, so enable it only there.
     if default_backend() == "cpu":
-        global_metrics().gauge("compile_cache_enabled").set(0)
+        mets.gauge("compile_cache_enabled").set(0)
         _done = True
-        return
+        return False
     cache_dir = os.environ.get(
         "CCTPU_COMPILE_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "consensusclustr_tpu", "xla"),
     )
+    enabled = False
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache even fast compiles: recursion levels re-enter many small jits
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        # RunRecord accounting: enabled flag + entry count at enable time (a
-        # warm-cache proxy — jax exposes no per-lookup hit counter); a later
-        # run with entries > 0 started warm.
-        global_metrics().gauge("compile_cache_enabled").set(1)
+        enabled = True
+        # RunRecord accounting: entry count at enable time (a warm-cache
+        # proxy — jax exposes no per-lookup hit counter); a later run with
+        # entries > 0 started warm.
         try:
-            global_metrics().gauge("compile_cache_entries").set(
-                len(os.listdir(cache_dir))
-            )
+            mets.gauge("compile_cache_entries").set(len(os.listdir(cache_dir)))
         except OSError:
             pass
     except Exception:
         pass  # cache is an optimisation, never a requirement
+    mets.gauge("compile_cache_enabled").set(1 if enabled else 0)
     _done = True
+    return enabled

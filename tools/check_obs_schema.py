@@ -42,8 +42,12 @@ METRIC_RE = re.compile(
 )
 
 # Scanned trees/files, relative to the repo root. Tests are exempt (they
-# exercise the machinery with throwaway names on purpose).
-SCAN = ("consensusclustr_tpu", "bench.py")
+# exercise the machinery with throwaway names on purpose). The package walk
+# covers every subpackage — serve/ (the online-assignment subsystem, ISSUE 3)
+# included; tests/test_serve.py pins that coverage so a future repo
+# reorganisation cannot silently drop it. Standalone drivers that emit
+# instrumentation are listed explicitly.
+SCAN = ("consensusclustr_tpu", "bench.py", os.path.join("tools", "serve_demo.py"))
 
 
 def _py_files(root: str) -> List[str]:

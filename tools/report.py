@@ -48,13 +48,16 @@ def _span_total(spans: List[dict]) -> float:
 
 def _phases(record: dict) -> dict:
     # records carry a precomputed top-level breakdown; fall back to deriving
-    # it from the span tree for hand-rolled files
+    # it from the span tree for hand-rolled files. Every span field access
+    # here and below uses .get: records written by older (or newer) schema
+    # versions must render, never KeyError.
     if record.get("phases"):
         return record["phases"]
     out: dict = {}
     for s in record.get("spans", []):
         if s.get("seconds") is not None:
-            out[s["name"]] = out.get(s["name"], 0.0) + s["seconds"]
+            name = s.get("name", "?")
+            out[name] = out.get(name, 0.0) + s["seconds"]
     return out
 
 
@@ -68,7 +71,8 @@ def phase_table(record: dict) -> str:
     phases = _phases(record)
     counts: dict = {}
     for s in record.get("spans", []):
-        counts[s["name"]] = counts.get(s["name"], 0) + 1
+        name = s.get("name", "?")
+        counts[name] = counts.get(name, 0) + 1
     lines = [f"{'phase':<22} {'calls':>5} {'seconds':>10} {'% wall':>7}"]
     for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
         lines.append(
@@ -138,6 +142,38 @@ def pipelining(record: dict) -> str:
     return "\n".join(lines) if lines else "(no pipelined phases)"
 
 
+def serving(record: dict) -> str:
+    """Latency/qps table for records carrying serve/ metrics (an
+    AssignmentService run_record, or any record merged with one). Older
+    records without serving metrics render the placeholder line — absence is
+    normal, never an error."""
+    m = record.get("metrics") or {}
+    hist = (m.get("histograms") or {}).get("serve_latency_seconds")
+    if not hist or not hist.get("count"):
+        return "(no serving activity)"
+    counters = m.get("counters") or {}
+    gauges = m.get("gauges") or {}
+    n = hist.get("count", 0)
+    wall = record.get("wall_s") or 0.0
+    lines = [f"{'requests':<28} {n}"]
+    if wall:
+        lines.append(f"{'qps':<28} {n / wall:.2f}")
+    for stat in ("mean", "min", "max"):
+        v = hist.get(stat)
+        if v is not None:
+            lines.append(f"{'latency ' + stat + ' (ms)':<28} {1000.0 * v:.3f}")
+    for label, key in (
+        ("bucket compiles", "serve_compile"),
+        ("rejections", "serve_rejections"),
+    ):
+        if key in counters:
+            lines.append(f"{label:<28} {counters[key]:g}")
+    for key in ("queue_depth", "batch_occupancy"):
+        if gauges.get(key) is not None:
+            lines.append(f"{key + ' (last)':<28} {gauges[key]:g}")
+    return "\n".join(lines)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -172,6 +208,7 @@ def render(record: dict) -> str:
         "", "== per-phase ==", phase_table(record),
         "", "== span tree ==", flame(record),
         "", "== pipelining ==", pipelining(record),
+        "", "== serving ==", serving(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Export-then-query driver for the serve/ subsystem: the whole online
+reference-mapping story in one command.
+
+    python tools/serve_demo.py                         # synthetic end to end
+    python tools/serve_demo.py --cells 1000 --queries 500
+    python tools/serve_demo.py --bundle /tmp/ref --keep-bundle
+    python tools/serve_demo.py --record serve_run.jsonl   # -> tools/report.py
+
+Steps (each printed as it runs):
+
+  1. fit      — consensus_clust on a synthetic NB mixture (utils/synth);
+  2. export   — api.export_reference → versioned, checksummed bundle;
+  3. load     — serve.load_reference (validates schema + checksum);
+  4. serve    — AssignmentService: warm-up compiles per bucket, then a burst
+                of mixed-size query batches with client-side retry on
+                backpressure;
+  5. verify   — the reference's own cells assigned back: must reproduce the
+                offline labels exactly (the self-assignment parity contract);
+  6. report   — qps, latency p50/p99, bucket compiles, and optionally the
+                service RunRecord for tools/report.py's "== serving ==" table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cells", type=int, default=400, help="reference cells")
+    ap.add_argument("--genes", type=int, default=200)
+    ap.add_argument("--populations", type=int, default=3)
+    ap.add_argument("--nboots", type=int, default=4)
+    ap.add_argument("--queries", type=int, default=300, help="total query cells")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="serve_max_batch (default: env/256)")
+    ap.add_argument("--bundle", default=None,
+                    help="bundle directory (default: a temp dir)")
+    ap.add_argument("--keep-bundle", action="store_true")
+    ap.add_argument("--record", default=None,
+                    help="append the service RunRecord JSONL here")
+    args = ap.parse_args(argv)
+
+    from consensusclustr_tpu.api import consensus_clust, export_reference
+    from consensusclustr_tpu.serve.artifact import load_reference
+    from consensusclustr_tpu.serve.service import (
+        AssignmentService,
+        RetryableRejection,
+    )
+    from consensusclustr_tpu.utils.synth import nb_mixture_counts
+
+    rng = np.random.default_rng(0)
+    print(f"[1/6] fit: {args.cells} cells x {args.genes} genes, "
+          f"{args.nboots} boots")
+    counts, _ = nb_mixture_counts(
+        n_cells=args.cells, n_genes=args.genes,
+        n_populations=args.populations, seed=11,
+    )
+    t0 = time.perf_counter()
+    res = consensus_clust(
+        counts, nboots=args.nboots, pc_num=5, k_num=(10,),
+        res_range=(0.3, 0.6, 0.9), test_significance=False, max_clusters=16,
+    )
+    print(f"      {res.n_clusters} clusters in {time.perf_counter() - t0:.1f}s")
+
+    bundle = args.bundle or tempfile.mkdtemp(prefix="cctpu_ref_")
+    print(f"[2/6] export -> {bundle}")
+    export_reference(res, bundle)
+
+    print("[3/6] load (schema + checksum validated)")
+    art = load_reference(bundle)
+    print(f"      schema={art.manifest['schema']} n={art.n_cells} "
+          f"hvg={art.n_hvg} pcs={art.pc_num} "
+          f"clusters={len(art.leaf_table)}")
+
+    print("[4/6] serve: warm-up + query burst")
+    sizes = rng.integers(1, 33, size=max(args.queries // 16, 1))
+    queries = [
+        counts[rng.integers(0, args.cells, int(s))] for s in sizes
+    ]
+    lat = []
+    with AssignmentService(art, max_batch=args.max_batch) as svc:
+        print(f"      buckets={svc.buckets} compiles={svc.bucket_compiles}")
+        t0 = time.perf_counter()
+        futs = []
+        for q in queries:
+            t_sub = time.perf_counter()
+            while True:
+                try:
+                    futs.append((t_sub, svc.submit(q)))
+                    break
+                except RetryableRejection:
+                    time.sleep(0.001)
+        for t_sub, f in futs:
+            f.result(timeout=300)
+            lat.append(time.perf_counter() - t_sub)
+        wall = time.perf_counter() - t0
+
+        print("[5/6] verify: self-assignment parity")
+        back = svc.assign(counts, timeout=600) if args.cells <= svc.max_batch \
+            else None
+        if back is None:
+            from consensusclustr_tpu.serve.assign import assign_cells
+
+            back = assign_cells(art, counts)
+        exact = bool(np.array_equal(back.labels, res.assignments))
+        print(f"      exact={exact} "
+              f"min_confidence={float(back.confidence.min()):.3f}")
+
+        lat_ms = np.sort(np.asarray(lat)) * 1000.0
+        print("[6/6] report")
+        print(f"      requests={len(queries)} qps={len(queries) / wall:.1f} "
+              f"cells/s={sizes.sum() / wall:.0f}")
+        print(f"      latency p50={np.percentile(lat_ms, 50):.2f}ms "
+              f"p99={np.percentile(lat_ms, 99):.2f}ms")
+        print(f"      bucket_compiles={svc.bucket_compiles} "
+              f"(buckets reused across {len(queries)} request sizes)")
+        if args.record:
+            svc.run_record().write(args.record)
+            print(f"      RunRecord -> {args.record} "
+                  f"(render: python tools/report.py {args.record})")
+
+    if args.bundle is None and not args.keep_bundle:
+        shutil.rmtree(bundle, ignore_errors=True)
+    elif args.keep_bundle:
+        print(f"bundle kept at {bundle}")
+    return 0 if exact else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

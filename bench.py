@@ -128,30 +128,31 @@ def _serving_rung() -> dict:
         queries = [
             rng.poisson(2.0, size=(int(s), g)).astype(np.float32) for s in sizes
         ]
-        lat: list = []
         with AssignmentService(
             art, max_batch=max_batch, queue_depth=16, warmup=True
         ) as svc:
             t0 = time.perf_counter()
             futs = []
             for q in queries:
-                t_sub = time.perf_counter()
                 while True:
                     try:
-                        futs.append((t_sub, svc.submit(q)))
+                        futs.append(svc.submit(q))
                         break
                     except RetryableRejection:
                         time.sleep(0.001)
-            for t_sub, f in futs:
+            for f in futs:
                 f.result(timeout=300)
-                lat.append(time.perf_counter() - t_sub)
             wall = time.perf_counter() - t0
             compiles = svc.bucket_compiles
-        lat_ms = np.sort(np.asarray(lat)) * 1000.0
+            # bucketed-histogram estimates (obs/hist.py): the same numbers
+            # tools/serve_demo.py prints and the /metrics endpoint scrapes
+            hist = svc.metrics.histogram("serve_latency_seconds")
+            p50 = 1000.0 * (hist.quantile(0.5) or 0.0)
+            p99 = 1000.0 * (hist.quantile(0.99) or 0.0)
         return {
             "qps": round(n_req / wall, 2),
-            "latency_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
-            "latency_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "latency_p50_ms": round(p50, 3),
+            "latency_p99_ms": round(p99, 3),
             "bucket_compiles": int(compiles),
             "cells_per_sec": round(float(sizes.sum()) / wall, 1),
             "requests": n_req,

@@ -154,6 +154,11 @@ class ClusterConfig:
     serve_queue_depth: Optional[int] = None   # bounded request-queue slots
     serve_max_batch: Optional[int] = None     # max rows per micro-batch
     serve_buckets: Optional[Sequence[int]] = None  # compiled pad-to sizes
+    # Prometheus /metrics + /healthz HTTP exporter on AssignmentService.
+    # None (and no CCTPU_SERVE_METRICS_PORT env) = off — serving never opens
+    # a socket unless asked (docs/quirks.md). 0 = bind an ephemeral port
+    # (the bound port is svc.metrics_port).
+    serve_metrics_port: Optional[int] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -193,6 +198,13 @@ class ClusterConfig:
             v = getattr(self, knob)
             if v is not None and int(v) < 1:
                 raise ValueError(f"{knob} must be >= 1; got {v}")
+        if self.serve_metrics_port is not None and not (
+            0 <= int(self.serve_metrics_port) <= 65535
+        ):
+            raise ValueError(
+                f"serve_metrics_port must be in [0, 65535] (0 = ephemeral) or "
+                f"None (off); got {self.serve_metrics_port}"
+            )
         if self.serve_buckets is not None:
             sb = [int(b) for b in self.serve_buckets]
             if not sb or any(b < 1 for b in sb):

@@ -16,9 +16,28 @@ Three pieces (ISSUE 1 tentpole), all host-side and import-light:
 existing ``log.event(...)`` call site feeds the same record stream.
 ``obs/schema.py`` registers all legal event/span/metric names;
 ``tools/check_obs_schema.py`` statically enforces the registry.
+
+The export layer (ISSUE 4 tentpole) turns that state into standard operator
+surfaces: ``obs/hist.py`` gives every ``Histogram`` fixed log-spaced buckets
+and a ``quantile(q)`` estimator; ``obs/export.py`` renders any span tree as
+Chrome/Perfetto trace-event JSON (``RunRecord.to_chrome_trace`` /
+``tools/report.py --trace``) and any metrics snapshot as Prometheus text
+(``MetricsRegistry.to_prom_text``, served live by ``AssignmentService`` when
+``CCTPU_SERVE_METRICS_PORT`` enables the scrape endpoint).
 """
 
+from consensusclustr_tpu.obs.export import (
+    chrome_trace_events,
+    prom_text_from_snapshot,
+    write_chrome_trace,
+)
+from consensusclustr_tpu.obs.hist import (
+    DEFAULT_BOUNDS,
+    bucket_quantile,
+    log_bounds,
+)
 from consensusclustr_tpu.obs.metrics import (
+    Histogram,
     MetricsRegistry,
     global_metrics,
     record_device_memory,
@@ -43,7 +62,9 @@ from consensusclustr_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "DEFAULT_BOUNDS",
     "EVENT_KINDS",
+    "Histogram",
     "METRIC_NAMES",
     "MetricsRegistry",
     "RunRecord",
@@ -51,11 +72,16 @@ __all__ = [
     "SPAN_NAMES",
     "Span",
     "Tracer",
+    "bucket_quantile",
+    "chrome_trace_events",
     "config_fingerprint",
     "global_metrics",
     "load_records",
+    "log_bounds",
     "maybe_span",
     "metrics_of",
+    "prom_text_from_snapshot",
     "record_device_memory",
     "tracer_of",
+    "write_chrome_trace",
 ]

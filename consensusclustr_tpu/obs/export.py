@@ -1,0 +1,252 @@
+"""Telemetry exporters: Chrome/Perfetto trace events + Prometheus text.
+
+Two operator-facing serializations of the obs/ state (ISSUE 4 tentpole):
+
+  * :func:`chrome_trace_events` — a ``Span`` tree (live ``Tracer.roots`` via
+    ``Span.to_dict``, or the ``spans`` of a persisted RunRecord) as
+    trace-event JSON: ``ph: "X"`` complete events with microsecond ``ts`` /
+    ``dur``, one ``tid`` lane per top-level phase name, span attrs as
+    ``args``, and the flat event stream as ``ph: "i"`` instants. The output
+    of :func:`write_chrome_trace` loads directly in ``ui.perfetto.dev`` /
+    ``chrome://tracing``.
+  * :func:`prom_text_from_snapshot` — a ``MetricsRegistry.snapshot()`` dict
+    in the Prometheus text exposition format (version 0.0.4): ``# HELP`` /
+    ``# TYPE`` headers, counters as ``_total``, histograms as cumulative
+    ``_bucket{le="..."}`` series plus ``_sum``/``_count``. This is what the
+    ``AssignmentService`` ``/metrics`` endpoint serves.
+
+Everything here operates on plain JSON-shaped dicts and stdlib types — no
+jax, no numpy — so ``tools/report.py`` can load this file directly (by path,
+package not required) on hosts without the accelerator stack. Sibling
+modules (hist.py for quantiles, schema.py for metric help text) are imported
+normally when the package is available and bootstrapped by file path when not.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+
+def _sibling(module: str):
+    """Import a sibling obs/ module, falling back to a direct file load when
+    the package is not importable (standalone tools/report.py usage)."""
+    try:
+        import importlib
+
+        return importlib.import_module(f"consensusclustr_tpu.obs.{module}")
+    except Exception:
+        import importlib.util
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), f"{module}.py"
+        )
+        spec = importlib.util.spec_from_file_location(f"_cctpu_obs_{module}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
+# -- Chrome / Perfetto trace events ------------------------------------------
+
+TRACE_PID = 1
+
+
+def _span_dict(span: Any) -> dict:
+    """Accept either a serialized span dict or a live Span object."""
+    return span if isinstance(span, dict) else span.to_dict()
+
+
+def _us(seconds: float) -> int:
+    return int(round(seconds * 1e6))
+
+
+def chrome_trace_events(
+    spans: Iterable[Any],
+    events: Iterable[dict] = (),
+) -> List[dict]:
+    """Trace-event list for a span tree (+ optional flat event stream).
+
+    Lanes: every distinct top-level span name gets its own ``tid`` (first-seen
+    order, 1-based); descendants inherit the root's lane, so nesting renders
+    as stack depth inside one track. ``tid`` 0 carries the flat events as
+    instants. Children are clamped into their parent's interval — span
+    timestamps are rounded independently at capture time, and the trace
+    contract (events on one tid must nest) is stricter than the tree's.
+    """
+    out: List[dict] = [
+        {
+            "name": "process_name", "ph": "M", "pid": TRACE_PID, "tid": 0,
+            "args": {"name": "consensusclustr_tpu"},
+        },
+    ]
+    lanes: Dict[str, int] = {}
+
+    def lane_for(root_name: str) -> int:
+        if root_name not in lanes:
+            lanes[root_name] = len(lanes) + 1
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": TRACE_PID,
+                "tid": lanes[root_name], "args": {"name": root_name},
+            })
+        return lanes[root_name]
+
+    def emit(span: dict, tid: int, lo_us: int, hi_us: Optional[int]) -> None:
+        ts = max(_us(float(span.get("t0") or 0.0)), lo_us)
+        seconds = span.get("seconds")
+        dur = _us(float(seconds)) if seconds is not None else 0
+        if hi_us is not None:
+            ts = min(ts, hi_us)
+            dur = min(dur, hi_us - ts)
+        dur = max(dur, 0)
+        args = dict(span.get("attrs") or {})
+        if seconds is None:
+            args["open"] = True
+        if not span.get("ok", True):
+            args["ok"] = False
+            args["error"] = span.get("error")
+        ev = {
+            "name": span.get("name", "?"), "cat": "span", "ph": "X",
+            "ts": ts, "dur": dur, "pid": TRACE_PID, "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        out.append(ev)
+        for child in span.get("children", []):
+            emit(_span_dict(child), tid, ts, ts + dur)
+
+    for root in spans:
+        d = _span_dict(root)
+        emit(d, lane_for(d.get("name", "?")), 0, None)
+
+    if any(lanes) or events:
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": TRACE_PID, "tid": 0,
+            "args": {"name": "events"},
+        })
+    for ev in events:
+        rec = {
+            "name": str(ev.get("kind", "event")), "cat": "event", "ph": "i",
+            "ts": _us(float(ev.get("t") or 0.0)), "pid": TRACE_PID, "tid": 0,
+            "s": "p",
+        }
+        args = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+        if args:
+            rec["args"] = args
+        out.append(rec)
+    return out
+
+
+def chrome_trace(
+    spans: Iterable[Any],
+    events: Iterable[dict] = (),
+    metadata: Optional[dict] = None,
+) -> dict:
+    """The full trace-object form ({"traceEvents": [...]}) Perfetto loads."""
+    doc = {
+        "traceEvents": chrome_trace_events(spans, events),
+        "displayTimeUnit": "ms",
+    }
+    if metadata:
+        doc["metadata"] = metadata
+    return doc
+
+
+def write_chrome_trace(
+    path: str,
+    spans: Iterable[Any],
+    events: Iterable[dict] = (),
+    metadata: Optional[dict] = None,
+) -> str:
+    """Serialize :func:`chrome_trace` to ``path``; returns the path."""
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans, events, metadata=metadata), f)
+    return path
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+PROM_PREFIX = "cctpu_"
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.10g}"
+
+
+def _esc_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _help_map() -> Dict[str, str]:
+    try:
+        return dict(_sibling("schema").METRIC_HELP)
+    except Exception:
+        return {}
+
+
+def prom_quantile(hist: dict, q: float) -> Optional[float]:
+    """Quantile estimate from a serialized histogram snapshot dict (the
+    ``bounds``/``bucket_counts`` fields); None for empty or bucket-less
+    (pre-schema-2) snapshots."""
+    bounds = hist.get("bounds")
+    counts = hist.get("bucket_counts")
+    if not bounds or not counts:
+        return None
+    return _sibling("hist").bucket_quantile(
+        bounds, counts, q, lo=hist.get("min"), hi=hist.get("max")
+    )
+
+
+def prom_text_from_snapshot(
+    snapshot: dict, help_map: Optional[Dict[str, str]] = None
+) -> str:
+    """Render a ``MetricsRegistry.snapshot()`` dict as Prometheus text.
+
+    Every series is prefixed ``cctpu_``; counters get the conventional
+    ``_total`` suffix; unset gauges are omitted (absence, not 0 — a serving
+    dashboard must not read "queue empty" from "never measured"); histogram
+    ``_bucket`` series are cumulative with a terminal ``le="+Inf"`` equal to
+    ``_count``. Ends with a trailing newline as the exposition format
+    requires.
+    """
+    if help_map is None:
+        help_map = _help_map()
+    lines: List[str] = []
+
+    def head(name: str, kind: str, base: str) -> None:
+        h = help_map.get(base)
+        if h:
+            lines.append(f"# HELP {name} {_esc_help(h)}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    for base, v in (snapshot.get("counters") or {}).items():
+        name = f"{PROM_PREFIX}{base}_total"
+        head(name, "counter", base)
+        lines.append(f"{name} {_fmt(v)}")
+    for base, v in (snapshot.get("gauges") or {}).items():
+        if v is None:
+            continue
+        name = f"{PROM_PREFIX}{base}"
+        head(name, "gauge", base)
+        lines.append(f"{name} {_fmt(v)}")
+    for base, h in (snapshot.get("histograms") or {}).items():
+        name = f"{PROM_PREFIX}{base}"
+        head(name, "histogram", base)
+        bounds: Sequence[float] = h.get("bounds") or ()
+        counts: Sequence[int] = h.get("bucket_counts") or ()
+        if bounds and counts:
+            cum = 0
+            for bound, c in zip(bounds, counts):
+                cum += int(c)
+                lines.append(
+                    f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}'
+                )
+            lines.append(f'{name}_bucket{{le="+Inf"}} {int(h.get("count", 0))}')
+        lines.append(f"{name}_sum {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{name}_count {int(h.get('count', 0))}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,112 @@
+"""Fixed log-spaced histogram buckets + quantile estimation.
+
+The PR-1 ``Histogram`` kept only count/sum/min/max — memory-bounded and
+hot-loop safe, but quantiles (the numbers a serving operator actually watches)
+had to be recomputed ad hoc from raw samples held elsewhere. This module adds
+the missing middle ground: a fixed ladder of log-spaced upper bounds (the
+Prometheus ``le`` convention — bucket i counts observations ``<= bounds[i]``,
+plus one overflow bucket for ``> bounds[-1]``). Memory stays O(len(bounds))
+per histogram regardless of observation count, ``observe`` costs one bisect,
+and ``quantile(q)`` is accurate to within the containing bucket's width.
+
+Kept stdlib-only (no numpy, no jax) so obs/export.py and tools/report.py can
+reuse the estimator on serialized snapshots from hosts without the stack.
+
+Bounds default to :data:`DEFAULT_BOUNDS` — 100 µs to 128 s at 4 buckets per
+decade (ratio ~1.78x) — sized for the latencies this package observes
+(``serve_latency_seconds``, ``chunk_overlap_seconds``, phase timings).
+Observations below the lowest bound land in bucket 0; the estimator uses the
+tracked min/max to tighten the first and overflow buckets' open edges.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import List, Optional, Sequence, Tuple
+
+
+def log_bounds(
+    lo: float = 1e-4, hi: float = 128.0, per_decade: int = 4
+) -> Tuple[float, ...]:
+    """Log-spaced ``le`` upper bounds from ``lo`` to at least ``hi``.
+
+    Successive bounds differ by a factor of ``10**(1/per_decade)``; the ladder
+    is generated multiplicatively and rounded to 10 significant digits so the
+    same call always yields the identical (mergeable) tuple.
+    """
+    if not (lo > 0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi; got lo={lo} hi={hi}")
+    if per_decade < 1:
+        raise ValueError(f"per_decade must be >= 1; got {per_decade}")
+    ratio = 10.0 ** (1.0 / per_decade)
+    out: List[float] = []
+    b = float(lo)
+    while True:
+        out.append(float(f"{b:.10g}"))
+        if out[-1] >= hi:
+            return tuple(out)
+        b *= ratio
+
+
+DEFAULT_BOUNDS: Tuple[float, ...] = log_bounds()
+
+# One (log) bucket step of the default ladder — tests and docs use it as the
+# "within one bucket width" tolerance on quantile estimates.
+DEFAULT_BUCKET_RATIO: float = 10.0 ** 0.25
+
+
+def bucket_index(bounds: Sequence[float], value: float) -> int:
+    """Index of the ``le`` bucket for ``value``: first i with
+    ``value <= bounds[i]``, or ``len(bounds)`` (the +Inf overflow bucket)."""
+    return bisect_left(bounds, value)
+
+
+def bucket_quantile(
+    bounds: Sequence[float],
+    counts: Sequence[int],
+    q: float,
+    lo: Optional[float] = None,
+    hi: Optional[float] = None,
+) -> Optional[float]:
+    """Estimate the q-quantile from per-bucket counts.
+
+    ``counts`` has ``len(bounds) + 1`` entries (the last is the overflow
+    bucket). Finds the bucket holding the ceil(q * n)-th observation and
+    interpolates linearly inside it; ``lo``/``hi`` (observed min/max, when
+    known) tighten the open edges of the first and overflow buckets and clamp
+    the result. Returns None for an empty histogram. The estimate is within
+    the containing bucket's width of the exact sample quantile by
+    construction — the bucket ratio is the precision knob.
+    """
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"quantile q must be in [0, 1]; got {q}")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"counts must have len(bounds)+1 entries; got {len(counts)} "
+            f"for {len(bounds)} bounds"
+        )
+    # rank of the target observation, 1-based; q=0 -> 1, q=1 -> total
+    target = max(1, min(total, int(-(-q * total // 1))))
+    cum = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            edge_lo = bounds[i - 1] if i > 0 else (lo if lo is not None else 0.0)
+            if i < len(bounds):
+                edge_hi = bounds[i]
+            else:  # overflow bucket: closed only when the max is known
+                edge_hi = hi if hi is not None else bounds[-1]
+            edge_lo = min(edge_lo, edge_hi)
+            frac = (target - cum) / c
+            est = edge_lo + frac * (edge_hi - edge_lo)
+            if lo is not None:
+                est = max(est, lo)
+            if hi is not None:
+                est = min(est, hi)
+            return est
+        cum += c
+    return hi  # unreachable when counts sum to total

@@ -1,4 +1,4 @@
-"""Metrics registry: counters, gauges, histograms.
+"""Metrics registry: counters, gauges, bucketed histograms.
 
 Host-side (never traced) accounting for the quantities the pipeline already
 knows but previously threw away: bootstraps completed, mesh fallbacks, best
@@ -9,12 +9,29 @@ JSON-able dict that lands in the RunRecord.
 Two scopes exist: the process-global registry (``global_metrics()``) for
 things that outlive one run (persistent compile cache), and a per-``Tracer``
 registry for run-local counts. ``RunRecord.from_tracer`` merges both.
+
+Histograms carry fixed log-spaced bucket counts (obs/hist.py) in addition to
+the streaming count/sum/min/max summary: memory stays bounded, ``observe``
+stays one bisect, and ``quantile(q)`` answers the p50/p99 questions that
+previously required keeping raw samples around. ``MetricsRegistry`` mutations
+that change the name->instrument maps (creation, ``merge``) are lock-guarded:
+the registry is written concurrently by ``AssignmentService`` worker threads
+and the ``AsyncChunkWriter`` background thread, and an unguarded ``setdefault``
+race can hand two threads distinct instruments for the same name (one of
+which silently loses its observations).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from consensusclustr_tpu.obs.hist import (
+    DEFAULT_BOUNDS,
+    bucket_index,
+    bucket_quantile,
+)
 
 
 @dataclasses.dataclass
@@ -39,13 +56,20 @@ class Gauge:
 
 @dataclasses.dataclass
 class Histogram:
-    """Streaming summary (count/sum/min/max) — no buckets, no raw samples,
-    so hot loops can observe() without growing memory."""
+    """Streaming summary (count/sum/min/max) + fixed log-spaced ``le``
+    buckets — memory-bounded, hot-loop safe (one bisect per observe), and
+    quantile-capable without retaining raw samples."""
 
     count: int = 0
     sum: float = 0.0
     min: Optional[float] = None
     max: Optional[float] = None
+    bounds: Tuple[float, ...] = DEFAULT_BOUNDS
+    bucket_counts: List[int] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.bucket_counts:
+            self.bucket_counts = [0] * (len(self.bounds) + 1)
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -53,68 +77,134 @@ class Histogram:
         self.sum += value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
+        self.bucket_counts[bucket_index(self.bounds, value)] += 1
 
     @property
     def mean(self) -> Optional[float]:
         return self.sum / self.count if self.count else None
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile from the bucket counts (None when empty, or
+        when a bounds-mismatched merge invalidated the buckets). Within one
+        bucket width of the exact sample quantile — see obs/hist.py."""
+        if not self.bucket_counts:
+            return None
+        return bucket_quantile(
+            self.bounds, self.bucket_counts, q, lo=self.min, hi=self.max
+        )
+
 
 class MetricsRegistry:
-    """Named counters/gauges/histograms with lazy creation and merge."""
+    """Named counters/gauges/histograms with lazy creation and merge.
+
+    Creation, ``merge`` and ``snapshot`` hold an internal lock (concurrent
+    writers: serving worker threads, the async checkpoint writer). Instrument
+    mutation (``inc``/``set``/``observe``) is intentionally not locked — each
+    writer owns its instruments by convention and a hot-loop lock would cost
+    more than it protects.
+    """
 
     def __init__(self) -> None:
         self.counters: Dict[str, Counter] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
-        return self.counters.setdefault(name, Counter())
+        try:
+            return self.counters[name]
+        except KeyError:
+            with self._lock:
+                return self.counters.setdefault(name, Counter())
 
     def gauge(self, name: str) -> Gauge:
-        return self.gauges.setdefault(name, Gauge())
+        try:
+            return self.gauges[name]
+        except KeyError:
+            with self._lock:
+                return self.gauges.setdefault(name, Gauge())
 
     def histogram(self, name: str) -> Histogram:
-        return self.histograms.setdefault(name, Histogram())
+        try:
+            return self.histograms[name]
+        except KeyError:
+            with self._lock:
+                return self.histograms.setdefault(name, Histogram())
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold ``other`` into self: counters add, later gauges win (when
-        set), histogram summaries combine. Returns self for chaining."""
-        for name, c in other.counters.items():
-            self.counter(name).inc(c.value)
-        for name, g in other.gauges.items():
-            if g.value is not None:
-                self.gauge(name).set(g.value)
-        for name, h in other.histograms.items():
-            mine = self.histogram(name)
-            mine.count += h.count
-            mine.sum += h.sum
-            for bound in ("min", "max"):
-                theirs = getattr(h, bound)
-                if theirs is None:
-                    continue
-                ours = getattr(mine, bound)
-                pick = theirs if ours is None else (
-                    min(ours, theirs) if bound == "min" else max(ours, theirs)
-                )
-                setattr(mine, bound, pick)
+        set), histogram summaries and bucket counts combine (buckets are
+        dropped on a bounds mismatch — the summary stays exact, quantiles
+        return None). Returns self for chaining."""
+        with self._lock:
+            for name, c in other.counters.items():
+                self.counters.setdefault(name, Counter()).inc(c.value)
+            for name, g in other.gauges.items():
+                if g.value is not None:
+                    self.gauges.setdefault(name, Gauge()).set(g.value)
+            for name, h in other.histograms.items():
+                mine = self.histograms.setdefault(name, Histogram())
+                mine.count += h.count
+                mine.sum += h.sum
+                for bound in ("min", "max"):
+                    theirs = getattr(h, bound)
+                    if theirs is None:
+                        continue
+                    ours = getattr(mine, bound)
+                    pick = theirs if ours is None else (
+                        min(ours, theirs) if bound == "min" else max(ours, theirs)
+                    )
+                    setattr(mine, bound, pick)
+                if (
+                    mine.bucket_counts
+                    and h.bucket_counts
+                    and tuple(mine.bounds) == tuple(h.bounds)
+                ):
+                    mine.bucket_counts = [
+                        a + b for a, b in zip(mine.bucket_counts, h.bucket_counts)
+                    ]
+                else:
+                    mine.bucket_counts = []
         return self
 
     def snapshot(self) -> dict:
-        """Flat JSON-able view; empty sections are dropped."""
-        out: dict = {}
-        if self.counters:
-            out["counters"] = {k: c.value for k, c in sorted(self.counters.items())}
-        if self.gauges:
-            out["gauges"] = {k: g.value for k, g in sorted(self.gauges.items())}
-        if self.histograms:
-            out["histograms"] = {
-                k: {
-                    "count": h.count, "sum": round(h.sum, 6),
-                    "min": h.min, "max": h.max, "mean": h.mean,
+        """Flat JSON-able view; empty sections are dropped. Histograms carry
+        their bucket ladder (``bounds`` + per-bucket ``bucket_counts``) so
+        serialized records keep quantiles answerable — obs/export.py and
+        tools/report.py re-estimate from exactly these fields."""
+        with self._lock:
+            out: dict = {}
+            if self.counters:
+                out["counters"] = {
+                    k: c.value for k, c in sorted(self.counters.items())
                 }
-                for k, h in sorted(self.histograms.items())
-            }
-        return out
+            if self.gauges:
+                out["gauges"] = {k: g.value for k, g in sorted(self.gauges.items())}
+            if self.histograms:
+                out["histograms"] = {
+                    k: {
+                        "count": h.count, "sum": round(h.sum, 6),
+                        "min": h.min, "max": h.max, "mean": h.mean,
+                        **(
+                            {
+                                "bounds": list(h.bounds),
+                                "bucket_counts": list(h.bucket_counts),
+                            }
+                            if h.bucket_counts
+                            else {}
+                        ),
+                    }
+                    for k, h in sorted(self.histograms.items())
+                }
+            return out
+
+    def to_prom_text(self) -> str:
+        """Prometheus text exposition (# HELP/# TYPE + samples) of the whole
+        registry; histograms emit cumulative ``_bucket{le=...}`` series plus
+        ``_sum``/``_count``. See obs/export.py for the format contract."""
+        from consensusclustr_tpu.obs.export import prom_text_from_snapshot
+
+        return prom_text_from_snapshot(self.snapshot())
 
 
 _GLOBAL = MetricsRegistry()

@@ -133,6 +133,24 @@ class RunRecord:
         with open(path, "a") as f:
             f.write(self.to_json() + "\n")
 
+    def to_chrome_trace(self, path: str) -> str:
+        """Export the span tree + event stream as Chrome/Perfetto trace-event
+        JSON (obs/export.py); the written file loads in ui.perfetto.dev.
+        Returns ``path``."""
+        from consensusclustr_tpu.obs.export import write_chrome_trace
+
+        return write_chrome_trace(
+            path,
+            [s.to_dict() for s in self.spans],
+            self.events,
+            metadata={
+                "schema": self.schema,
+                "backend": self.backend,
+                "config_fingerprint": self.config_fingerprint,
+                "wall_s": self.wall_s,
+            },
+        )
+
     @classmethod
     def from_dict(cls, d: dict) -> "RunRecord":
         return cls(

@@ -16,7 +16,11 @@ changes shape.
 
 from __future__ import annotations
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 4): Histogram serialization gained ``bounds``/``bucket_counts``
+# fields (log-spaced le buckets, obs/hist.py); ``phase_seconds`` histogram and
+# the ``serve_metrics`` event were added; METRIC_HELP (below) became part of
+# the registry contract.
+SCHEMA_VERSION = 2
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -52,6 +56,7 @@ EVENT_KINDS = frozenset({
     # serve/service.py
     "serve_start",
     "serve_drain",
+    "serve_metrics",   # /metrics + /healthz HTTP exporter came up (port attr)
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -81,26 +86,33 @@ SPAN_NAMES = frozenset({
     "serve_warmup",     # bucket-ladder compile pass at service load
 })
 
-# Metrics registry names (counters, gauges, histograms).
-METRIC_NAMES = frozenset({
-    "boots_completed",          # counter: bootstraps actually computed (not resumed)
-    "boots_resumed",            # counter: bootstraps loaded from checkpoint
-    "leiden_iters",             # counter: community-detection local-move iterations dispatched
-    "null_sims_completed",      # counter: null-model simulations finished
-    "mesh_fallbacks",           # counter: sharded levels that fell back to single-chip
-    "silhouette_best",          # gauge: last consensus silhouette
-    "compile_cache_enabled",    # gauge: 1 when the persistent XLA cache is active
-    "compile_cache_entries",    # gauge: cache-dir entries at enable time (warm-cache proxy)
-    "device_bytes_in_use",      # gauge: jax device memory_stats() at record time
-    "device_peak_bytes_in_use", # gauge: peak device memory, when the backend reports it
-    "boot_chunk_seconds",       # histogram: dispatch->fetch latency per computed boot chunk
-    "inflight_chunks",          # gauge: high-water mark of concurrently in-flight pipelined chunks
-    "chunk_overlap_seconds",    # histogram: per chunk, seconds between dispatch and the host blocking on its fetch
+# Metric name -> one-line help text. This IS the metric registry: the name
+# set below derives from it, the Prometheus exporter (obs/export.py) emits
+# each entry as the series' # HELP line, and tools/check_obs_schema.py fails
+# the suite if a name is registered without help (or vice versa).
+METRIC_HELP = {
+    "boots_completed": "counter: bootstraps actually computed (not resumed)",
+    "boots_resumed": "counter: bootstraps loaded from checkpoint",
+    "leiden_iters": "counter: community-detection local-move iterations dispatched",
+    "null_sims_completed": "counter: null-model simulations finished",
+    "mesh_fallbacks": "counter: sharded levels that fell back to single-chip",
+    "silhouette_best": "gauge: last consensus silhouette",
+    "compile_cache_enabled": "gauge: 1 when the persistent XLA cache is active",
+    "compile_cache_entries": "gauge: cache-dir entries at enable time (warm-cache proxy)",
+    "device_bytes_in_use": "gauge: jax device memory_stats() at record time",
+    "device_peak_bytes_in_use": "gauge: peak device memory, when the backend reports it",
+    "boot_chunk_seconds": "histogram: dispatch->fetch latency per computed boot chunk",
+    "inflight_chunks": "gauge: high-water mark of concurrently in-flight pipelined chunks",
+    "chunk_overlap_seconds": "histogram: per chunk, seconds between dispatch and the host blocking on its fetch",
+    "phase_seconds": "histogram: wall seconds per closed top-level pipeline phase span",
     # serve/ — the online assignment subsystem
-    "serve_latency_seconds",    # histogram: submit -> result per request
-    "queue_depth",              # gauge: request-queue occupancy at last submit/dequeue
-    "batch_occupancy",          # gauge: rows/bucket fill of the last micro-batch
-    "serve_compile",            # counter: bucket-shape first dispatches (XLA compiles)
-    "serve_rejections",         # counter: queue-full backpressure rejections
-    "compile_cache_enable_calls",  # counter: enable_persistent_cache invocations (idempotency telemetry)
-})
+    "serve_latency_seconds": "histogram: submit -> result per request",
+    "queue_depth": "gauge: request-queue occupancy at last submit/dequeue",
+    "batch_occupancy": "gauge: rows/bucket fill of the last micro-batch",
+    "serve_compile": "counter: bucket-shape first dispatches (XLA compiles)",
+    "serve_rejections": "counter: queue-full backpressure rejections",
+    "compile_cache_enable_calls": "counter: enable_persistent_cache invocations (idempotency telemetry)",
+}
+
+# Metrics registry names (counters, gauges, histograms).
+METRIC_NAMES = frozenset(METRIC_HELP)

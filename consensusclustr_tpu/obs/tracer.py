@@ -135,6 +135,10 @@ class Tracer:
                 except Exception:
                     pass
             self._stack.pop()
+            if not self._stack:
+                # top-level phase timings ride the bucketed histogram path so
+                # RunRecords / /metrics can answer phase-duration quantiles
+                self.metrics.histogram("phase_seconds").observe(sp.seconds)
             if self.progress:
                 self._emit({
                     "t": sp.t0, "kind": "span", "name": self.span_path(sp.name),

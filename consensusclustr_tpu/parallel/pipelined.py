@@ -30,6 +30,9 @@ Observability (names registered in obs/schema.py):
   dispatch and the moment the host blocked on its fetch: the window in which
   device compute could overlap host work (fetch of earlier chunks, checkpoint
   IO, the next dispatch). An upper bound on realized overlap; ~0 at depth 1.
+  Like every histogram it carries log-spaced bucket counts (obs/hist.py), so
+  per-chunk overlap quantiles survive into RunRecords and /metrics scrapes
+  without retaining per-chunk samples.
 
 The window knob is ``CCTPU_PIPELINE_DEPTH`` (default 2), overridable per call
 (``ClusterConfig.pipeline_depth`` / the ``pipeline_depth=`` arguments).

@@ -28,6 +28,14 @@ Observability (names registered in obs/schema.py):
   * ``serve_compile`` counter — bucket-shape first dispatches (compiles);
   * ``serve_rejections`` counter — backpressure rejections.
 
+Scrape endpoint (ISSUE 4): when ``serve_metrics_port`` /
+``CCTPU_SERVE_METRICS_PORT`` names a port (0 = ephemeral; default OFF), a
+stdlib ``http.server`` daemon thread serves ``/metrics`` (Prometheus text via
+``MetricsRegistry.to_prom_text`` — latency quantiles come from the bucketed
+``serve_latency_seconds`` histogram) and ``/healthz`` (queue depth, in-flight
+count, drain state as JSON) on localhost. The exporter starts with
+``start()``, survives the drain, and closes with ``close()``.
+
 Knob resolution follows the package's env-override pattern
 (parallel/pipelined.pipeline_depth): explicit argument >
 ``ClusterConfig.serve_*`` field > ``CCTPU_SERVE_*`` env var > default.
@@ -81,6 +89,87 @@ def serve_queue_depth(requested: Optional[int] = None) -> int:
     return v
 
 
+def serve_metrics_port(requested: Optional[int] = None) -> Optional[int]:
+    """Explicit arg > $CCTPU_SERVE_METRICS_PORT > off (None).
+
+    None means "do not open a socket" — the scrape endpoint is strictly
+    opt-in (docs/quirks.md). 0 binds an ephemeral port (read it back from
+    ``AssignmentService.metrics_port``).
+    """
+    if requested is None:
+        env = os.environ.get("CCTPU_SERVE_METRICS_PORT", "").strip().lower()
+        if env in ("", "off", "none"):
+            return None
+        requested = env
+    v = int(requested)
+    if not (0 <= v <= 65535):
+        raise ValueError(
+            f"serve_metrics_port must be in [0, 65535] (0 = ephemeral); got {v}"
+        )
+    return v
+
+
+class _MetricsHTTPServer:
+    """Stdlib-only /metrics (Prometheus text) + /healthz (JSON) exporter.
+
+    One daemon thread around ``http.server.ThreadingHTTPServer``, bound to
+    localhost only — operators front it with their own ingress. Handlers read
+    live service state (the registry snapshot is lock-guarded); nothing here
+    touches the device, so a scrape can never stall the worker loop.
+    """
+
+    def __init__(self, service: "AssignmentService", port: int) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet: obs, not stderr
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                from consensusclustr_tpu.obs.export import PROM_CONTENT_TYPE
+
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200, svc.metrics.to_prom_text().encode(),
+                            PROM_CONTENT_TYPE,
+                        )
+                    elif path == "/healthz":
+                        import json as _json
+
+                        self._send(
+                            200, (_json.dumps(svc.health()) + "\n").encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="cctpu-metrics-http", daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join()
+
+
 class _Request:
     __slots__ = ("counts_hvg", "mode", "future", "t_submit", "rows")
 
@@ -121,6 +210,7 @@ class AssignmentService:
         warmup: bool = True,
         start: bool = True,
         tracer: Optional[Tracer] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         if mode not in ("robust", "granular"):
             raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
@@ -152,6 +242,15 @@ class AssignmentService:
         self._thread: Optional[threading.Thread] = None
         self._closing = False
         self._closed = False
+        self._metrics_port_req = serve_metrics_port(
+            metrics_port
+            if metrics_port is not None
+            else getattr(cfg, "serve_metrics_port", None)
+        )
+        self._http: Optional[_MetricsHTTPServer] = None
+        self.metrics_port: Optional[int] = None  # bound port once started
+        self._accepted = 0
+        self._completed = 0
         if warmup:
             self.warmup()
         if start:
@@ -202,6 +301,10 @@ class AssignmentService:
                 max_batch=self.max_batch,
                 buckets=list(self.buckets),
             )
+        if self._metrics_port_req is not None and self._http is None:
+            self._http = _MetricsHTTPServer(self, self._metrics_port_req)
+            self.metrics_port = self._http.port
+            self.tracer.event("serve_metrics", port=self.metrics_port)
 
     def close(self) -> None:
         """Stop intake, drain everything queued, join the worker."""
@@ -225,6 +328,11 @@ class AssignmentService:
                     )
         self._closed = True
         self.tracer.event("serve_drain")
+        # the exporter outlives the drain (a scrape during shutdown must see
+        # final numbers), then closes with the service
+        if self._http is not None:
+            self._http.close()
+            self._http = None
 
     def __enter__(self) -> "AssignmentService":
         return self
@@ -260,6 +368,7 @@ class AssignmentService:
             raise RetryableRejection(
                 f"queue full ({self.queue_depth} requests in flight); retry"
             ) from None
+        self._accepted += 1
         self.metrics.gauge("queue_depth").set(self._queue.qsize())
         return req.future
 
@@ -332,11 +441,13 @@ class AssignmentService:
                     t_done - req.t_submit
                 )
                 req.future.set_result(result)
+                self._completed += 1
                 s = e
         except BaseException as e:  # fail the whole batch, keep serving
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(e)
+                    self._completed += 1
 
     # -- introspection -------------------------------------------------------
 
@@ -346,6 +457,23 @@ class AssignmentService:
 
     def stats(self) -> dict:
         return self.metrics.snapshot()
+
+    def health(self) -> dict:
+        """Liveness/drain snapshot (the /healthz body): queue depth, requests
+        in flight, and the compiled-shape inventory."""
+        status = (
+            "closed" if self._closed else "draining" if self._closing else "ok"
+        )
+        return {
+            "status": status,
+            "queue_depth": self._queue.qsize(),
+            "in_flight": self._accepted - self._completed,
+            "accepted": self._accepted,
+            "completed": self._completed,
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "bucket_compiles": self.bucket_compiles,
+        }
 
     def run_record(self, config=None) -> RunRecord:
         """Snapshot the service's spans/metrics as a RunRecord (for

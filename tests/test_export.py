@@ -1,0 +1,565 @@
+"""Telemetry export layer (ISSUE 4): Chrome/Perfetto traces, bucketed
+histogram quantiles, Prometheus text + live /metrics endpoint, bench_diff.
+
+Pins the acceptance criteria: a CPU smoke run's record exports a Chrome trace
+that json.loads with >= 10 complete events and the expected span names /
+monotonic timestamps; to_prom_text output is grammar-parseable with
+consistent _sum/_count; Histogram.quantile tracks np.percentile to within one
+bucket; the AssignmentService /metrics endpoint serves latencies that agree
+with raw client-side samples to within one bucket width and shuts down with
+the drain; tools/bench_diff.py gates the committed BENCH_*.json pair.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.obs import (
+    MetricsRegistry,
+    RunRecord,
+    SCHEMA_VERSION,
+    Tracer,
+    chrome_trace_events,
+)
+from consensusclustr_tpu.obs.hist import (
+    DEFAULT_BOUNDS,
+    DEFAULT_BUCKET_RATIO,
+    bucket_index,
+    bucket_quantile,
+    log_bounds,
+)
+from consensusclustr_tpu.obs.metrics import Histogram
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# name [{labels}] value — the subset of the Prometheus text grammar we emit
+_PROM_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*)\})?'
+    r' (?P<value>-?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|\+Inf|-Inf|NaN))$'
+)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _parse_prom(text):
+    """{name: [(labels_dict, value)]} for every sample line; asserts grammar."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line.startswith("#"):
+                assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$", line), line
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, f"unparseable prometheus sample line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for part in m.group("labels").split(","):
+                k, v = part.split("=", 1)
+                labels[k] = v.strip('"')
+        v = m.group("value")
+        value = float("inf") if v == "+Inf" else float(v)
+        out.setdefault(m.group("name"), []).append((labels, value))
+    return out
+
+
+# -----------------------------------------------------------------------------
+# bucketed histograms + quantiles
+# -----------------------------------------------------------------------------
+
+
+class TestBucketedHistogram:
+    def test_log_bounds_ladder(self):
+        b = log_bounds(1e-3, 1.0, per_decade=2)
+        assert b[0] == pytest.approx(1e-3)
+        assert b[-1] >= 1.0
+        ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+        assert all(r == pytest.approx(10 ** 0.5, rel=1e-6) for r in ratios)
+        assert DEFAULT_BOUNDS[-1] >= 128.0
+        with pytest.raises(ValueError):
+            log_bounds(0.0, 1.0)
+
+    def test_observe_fills_buckets_and_summary(self):
+        h = Histogram()
+        for v in (0.0, 1e-5, 0.01, 0.5, 1e6):  # below-lowest, mid, overflow
+            h.observe(v)
+        assert h.count == 5 and sum(h.bucket_counts) == 5
+        assert h.bucket_counts[0] == 2          # 0.0 and 1e-5 land in le=1e-4
+        assert h.bucket_counts[-1] == 1         # 1e6 overflows
+        assert h.min == 0.0 and h.max == 1e6
+
+    def test_quantile_within_one_bucket_of_percentile(self):
+        rng = np.random.default_rng(7)
+        samples = rng.lognormal(mean=-3.0, sigma=1.5, size=4000)
+        h = Histogram()
+        for s in samples:
+            h.observe(float(s))
+        for q in (0.05, 0.25, 0.5, 0.9, 0.99):
+            est = h.quantile(q)
+            true = float(np.percentile(samples, 100.0 * q))
+            # "within one bucket width": same or adjacent rung of the ladder
+            assert abs(bucket_index(h.bounds, est) - bucket_index(h.bounds, true)) <= 1, (
+                q, est, true)
+            assert est / true < DEFAULT_BUCKET_RATIO ** 2
+            assert true / est < DEFAULT_BUCKET_RATIO ** 2
+
+    def test_quantile_edge_cases(self):
+        assert Histogram().quantile(0.5) is None
+        h = Histogram()
+        h.observe(0.02)
+        assert h.quantile(0.0) == pytest.approx(0.02, rel=0.8)
+        assert h.quantile(1.0) == 0.02  # clamped to max
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            bucket_quantile((1.0,), (1,), 0.5)  # counts must be len(bounds)+1
+
+    def test_merge_sums_buckets(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        for v in (0.01, 0.02):
+            a.histogram("h").observe(v)
+        for v in (0.04, 10.0):
+            b.histogram("h").observe(v)
+        a.merge(b)
+        h = a.histograms["h"]
+        assert h.count == 4 and sum(h.bucket_counts) == 4
+        assert h.quantile(0.5) is not None
+
+    def test_merge_mismatched_bounds_drops_buckets_keeps_summary(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.histograms["h"] = Histogram(bounds=log_bounds(1e-2, 1.0))
+        a.histogram("h").observe(0.5)
+        b.histogram("h").observe(2.0)
+        a.merge(b)
+        h = a.histograms["h"]
+        assert h.count == 2 and h.max == 2.0       # summary stays exact
+        assert h.bucket_counts == [] and h.quantile(0.5) is None
+        snap = a.snapshot()["histograms"]["h"]
+        assert "bounds" not in snap and snap["count"] == 2
+
+    def test_snapshot_carries_buckets_and_roundtrips_json(self):
+        reg = MetricsRegistry()
+        reg.histogram("boot_chunk_seconds").observe(0.5)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        h = snap["histograms"]["boot_chunk_seconds"]
+        assert len(h["bucket_counts"]) == len(h["bounds"]) + 1
+        assert sum(h["bucket_counts"]) == 1
+
+    def test_registry_creation_is_thread_safe(self):
+        reg = MetricsRegistry()
+        seen = []
+
+        def worker():
+            c = reg.counter("x")
+            h = reg.histogram("h")
+            seen.append((id(c), id(h)))
+            for _ in range(200):
+                reg.counter(f"n{threading.get_ident() % 7}")
+                reg.merge(MetricsRegistry())
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every thread got the SAME instrument instances (no setdefault race
+        # handing out a second Histogram whose observations would vanish)
+        assert len({ids for ids in seen}) == 1
+        reg.snapshot()  # and snapshot still serializes under concurrency
+
+
+# -----------------------------------------------------------------------------
+# Chrome / Perfetto trace export
+# -----------------------------------------------------------------------------
+
+
+class TestChromeTrace:
+    def _tracer(self):
+        tr = Tracer()
+        with tr.span("level", depth=1):
+            with tr.span("boots", nboots=2):
+                tr.event("boots", done=2)
+            with tr.span("consensus"):
+                pass
+        with pytest.raises(RuntimeError):
+            with tr.span("assemble"):
+                raise RuntimeError("boom")
+        return tr
+
+    def test_event_structure_and_lanes(self):
+        tr = self._tracer()
+        events = chrome_trace_events([s.to_dict() for s in tr.roots], tr.events)
+        complete = [e for e in events if e["ph"] == "X"]
+        names = [e["name"] for e in complete]
+        assert names == ["level", "boots", "consensus", "assemble"]
+        lanes = {e["name"]: e["tid"] for e in complete}
+        assert lanes["boots"] == lanes["level"]          # child inherits lane
+        assert lanes["assemble"] != lanes["level"]       # new root, new lane
+        failed = next(e for e in complete if e["name"] == "assemble")
+        assert failed["args"]["ok"] is False
+        assert failed["args"]["error"] == "RuntimeError"
+        instants = [e for e in events if e["ph"] == "i"]
+        assert [e["name"] for e in instants] == ["boots"]
+        meta = [e for e in events if e["ph"] == "M"]
+        assert any(e["args"]["name"] == "consensusclustr_tpu" for e in meta)
+
+    def test_children_clamped_into_parent(self):
+        spans = [{
+            "name": "p", "t0": 1.0, "seconds": 1.0,
+            "children": [
+                {"name": "c1", "t0": 0.9, "seconds": 0.5},   # starts early
+                {"name": "c2", "t0": 1.9, "seconds": 0.5},   # overruns end
+            ],
+        }]
+        evs = [e for e in chrome_trace_events(spans) if e["ph"] == "X"]
+        p, c1, c2 = evs
+        assert c1["ts"] >= p["ts"]
+        assert c2["ts"] + c2["dur"] <= p["ts"] + p["dur"]
+        # DFS emission order keeps ts monotonic within the lane
+        assert p["ts"] <= c1["ts"] <= c2["ts"]
+
+    def test_open_span_marked(self):
+        evs = chrome_trace_events([{"name": "p", "t0": 0.0, "seconds": None}])
+        span = next(e for e in evs if e["ph"] == "X")
+        assert span["dur"] == 0 and span["args"]["open"] is True
+
+    @pytest.mark.smoke
+    def test_smoke_run_record_exports_valid_trace(self, tmp_path):
+        """Acceptance: a real CPU smoke run -> >= 10 complete events that
+        json.load, with the pipeline's span names and monotonic timestamps."""
+        from consensusclustr_tpu.api import consensus_clust
+
+        rng = np.random.default_rng(0)
+        centers = rng.normal(0, 6, size=(3, 6))
+        pca = (
+            centers[rng.integers(0, 3, size=96)] + rng.normal(0, 1, (96, 6))
+        ).astype(np.float32)
+        res = consensus_clust(
+            pca=pca, pc_num=6, nboots=2, k_num=(5,), res_range=(0.3, 0.9),
+            max_clusters=16, test_significance=False,
+        )
+        path = str(tmp_path / "trace.json")
+        assert res.run_record.to_chrome_trace(path) == path
+        doc = json.load(open(path))
+        complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(complete) >= 10
+        names = {e["name"] for e in complete}
+        assert {"ingest", "level", "assemble", "consensus", "boots"} <= names
+        by_lane = {}
+        for e in complete:
+            assert e["ts"] >= 0 and e["dur"] >= 0 and e["pid"] == 1
+            by_lane.setdefault(e["tid"], []).append(e["ts"])
+        for lane_ts in by_lane.values():  # DFS order -> monotonic per lane
+            assert lane_ts == sorted(lane_ts)
+        assert doc["metadata"]["schema"] == SCHEMA_VERSION
+
+    def test_report_cli_trace_flag(self, tmp_path):
+        tr = self._tracer()
+        rec_path = str(tmp_path / "rr.jsonl")
+        RunRecord.from_tracer(tr, include_global_metrics=False).write(rec_path)
+        trace_path = str(tmp_path / "out.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "report.py"),
+             rec_path, "--trace", trace_path],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "perfetto" in proc.stdout
+        doc = json.load(open(trace_path))
+        assert [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"] == [
+            "level", "boots", "consensus", "assemble"
+        ]
+
+
+# -----------------------------------------------------------------------------
+# Prometheus text export
+# -----------------------------------------------------------------------------
+
+
+class TestPromText:
+    def _registry(self):
+        reg = MetricsRegistry()
+        reg.counter("serve_compile").inc(3)
+        reg.counter("serve_rejections")
+        reg.gauge("queue_depth").set(2)
+        reg.gauge("silhouette_best")  # unset: must be omitted
+        for v in (0.001, 0.004, 0.004, 0.02, 3.0):
+            reg.histogram("serve_latency_seconds").observe(v)
+        return reg
+
+    def test_grammar_and_consistency(self):
+        text = self._registry().to_prom_text()
+        assert text.endswith("\n")
+        samples = _parse_prom(text)
+        assert samples["cctpu_serve_compile_total"][0][1] == 3
+        assert samples["cctpu_queue_depth"][0][1] == 2
+        assert "cctpu_silhouette_best" not in samples
+        # histogram: _count == observations, _sum matches, buckets cumulative
+        assert samples["cctpu_serve_latency_seconds_count"][0][1] == 5
+        assert samples["cctpu_serve_latency_seconds_sum"][0][1] == pytest.approx(
+            3.029, rel=1e-6
+        )
+        buckets = samples["cctpu_serve_latency_seconds_bucket"]
+        values = [v for _, v in buckets]
+        assert values == sorted(values)  # cumulative
+        assert buckets[-1][0]["le"] == "+Inf" and buckets[-1][1] == 5
+        les = [float(l["le"]) if l["le"] != "+Inf" else np.inf for l, _ in buckets]
+        assert les == sorted(les)
+
+    def test_help_lines_from_schema_registry(self):
+        from consensusclustr_tpu.obs.schema import METRIC_HELP
+
+        text = self._registry().to_prom_text()
+        assert (
+            f"# HELP cctpu_queue_depth {METRIC_HELP['queue_depth']}" in text
+        )
+        assert "# TYPE cctpu_serve_latency_seconds histogram" in text
+
+    def test_bucketless_snapshot_renders_sum_count_only(self):
+        # pre-schema-2 snapshots (e.g. merged-mismatch) still export
+        from consensusclustr_tpu.obs.export import prom_text_from_snapshot
+
+        snap = {"histograms": {"h": {"count": 2, "sum": 1.0}}}
+        samples = _parse_prom(prom_text_from_snapshot(snap, help_map={}))
+        assert samples["cctpu_h_count"][0][1] == 2
+        assert "cctpu_h_bucket" not in samples
+
+
+# -----------------------------------------------------------------------------
+# live /metrics endpoint on AssignmentService
+# -----------------------------------------------------------------------------
+
+
+def _tiny_artifact(n=48, n_genes=12, d=4, seed=0):
+    from consensusclustr_tpu.serve.artifact import ReferenceArtifact, level_tables
+    from consensusclustr_tpu.serve.assign import embed_reference_counts
+
+    rng = np.random.default_rng(seed)
+    loadings = np.linalg.qr(rng.normal(size=(n_genes, d)))[0].astype(np.float32)
+    mu = np.zeros(n_genes, np.float32)
+    sigma = np.ones(n_genes, np.float32)
+    counts = rng.poisson(3.0, size=(n, n_genes)).astype(np.float32)
+    libsize_mean = float(counts.sum(1).mean())
+    emb = embed_reference_counts(counts, mu, sigma, loadings, libsize_mean)
+    codes, tables = level_tables(
+        np.asarray([str(i % 3 + 1) for i in range(n)], dtype=object)
+    )
+    art = ReferenceArtifact(
+        embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+        libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+        stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+    )
+    return art, counts
+
+
+class TestMetricsEndpoint:
+    def test_off_by_default(self, monkeypatch):
+        from consensusclustr_tpu.serve.service import serve_metrics_port
+
+        monkeypatch.delenv("CCTPU_SERVE_METRICS_PORT", raising=False)
+        assert serve_metrics_port() is None
+        monkeypatch.setenv("CCTPU_SERVE_METRICS_PORT", "off")
+        assert serve_metrics_port() is None
+        monkeypatch.setenv("CCTPU_SERVE_METRICS_PORT", "9109")
+        assert serve_metrics_port() == 9109
+        assert serve_metrics_port(0) == 0
+        with pytest.raises(ValueError):
+            serve_metrics_port(70000)
+
+    def test_config_knob_validation(self):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        assert ClusterConfig(serve_metrics_port=0).serve_metrics_port == 0
+        with pytest.raises(ValueError):
+            ClusterConfig(serve_metrics_port=-1)
+
+    @pytest.mark.smoke
+    def test_scrape_quantiles_match_raw_samples_and_drain(self):
+        """Acceptance: /metrics p50/p99 vs raw client-side latency samples
+        within one bucket width; endpoint dies with the service drain."""
+        import time
+
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = _tiny_artifact()
+        rng = np.random.default_rng(1)
+        raw = []
+        svc = AssignmentService(art, max_batch=8, metrics_port=0)
+        try:
+            assert svc.metrics_port is not None and svc.metrics_port > 0
+            url = f"http://127.0.0.1:{svc.metrics_port}"
+            for _ in range(24):
+                t0 = time.perf_counter()
+                svc.assign(counts[rng.integers(0, len(counts), 3)])
+                raw.append(time.perf_counter() - t0)
+            body = urllib.request.urlopen(url + "/metrics", timeout=10)
+            assert body.headers["Content-Type"].startswith("text/plain")
+            samples = _parse_prom(body.read().decode())
+            assert samples["cctpu_serve_latency_seconds_count"][0][1] == 24
+
+            # rebuild the quantile from the scraped buckets, compare to raw
+            buckets = samples["cctpu_serve_latency_seconds_bucket"]
+            bounds = [float(l["le"]) for l, _ in buckets if l["le"] != "+Inf"]
+            cum = [v for _, v in buckets]
+            counts_per = [cum[0]] + [
+                cum[i] - cum[i - 1] for i in range(1, len(cum))
+            ]
+            for q in (0.5, 0.99):
+                est = bucket_quantile(bounds, counts_per, q)
+                true = float(np.percentile(raw, 100.0 * q))
+                lo_i = bucket_index(bounds, true)
+                lo = bounds[lo_i - 1] if lo_i > 0 else 0.0
+                hi = bounds[lo_i] if lo_i < len(bounds) else true
+                # within the raw percentile's bucket, +/- one bucket step
+                assert lo / DEFAULT_BUCKET_RATIO <= est <= hi * DEFAULT_BUCKET_RATIO, (
+                    q, est, true)
+
+            hz = json.load(urllib.request.urlopen(url + "/healthz", timeout=10))
+            assert hz["status"] == "ok" and hz["in_flight"] == 0
+            assert hz["accepted"] == 24 and hz["completed"] == 24
+        finally:
+            svc.close()
+        # drain closed the exporter: the socket must refuse
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+
+    def test_no_socket_when_disabled(self, monkeypatch):
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        monkeypatch.delenv("CCTPU_SERVE_METRICS_PORT", raising=False)
+        art, _ = _tiny_artifact(n=16)
+        with AssignmentService(art, max_batch=4, warmup=False) as svc:
+            assert svc.metrics_port is None and svc._http is None
+
+
+# -----------------------------------------------------------------------------
+# bench_diff regression gate
+# -----------------------------------------------------------------------------
+
+
+def _payload(value=1.0, schema=2, **extra):
+    d = {"metric": "m", "value": value, "unit": "boots/s",
+         "obs_schema": schema, "wall_s": 10.0 / value,
+         "serving": {"qps": 20.0 * value, "latency_p99_ms": 5.0 / value}}
+    d.update(extra)
+    return d
+
+
+class TestBenchDiff:
+    def _run(self, tmp_path, old, new, *extra):
+        po, pn = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+        json.dump(old, open(po, "w"))
+        json.dump(new, open(pn, "w"))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             po, pn, *extra],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_check_mode_on_committed_pair(self):
+        """The tier-1 hook (ISSUE 4 satellite): the repo's own newest
+        BENCH_*.json pair must validate — malformed lines or schema drift in
+        committed bench artifacts fail the suite here."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             "--check", "--dir", REPO_ROOT],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bench_diff: ok" in proc.stdout
+
+    def test_gate_passes_and_fails(self, tmp_path):
+        ok = self._run(tmp_path, _payload(1.0), _payload(0.9),
+                       "--gate", "value:0.5")
+        assert ok.returncode == 0, ok.stderr
+        bad = self._run(tmp_path, _payload(1.0), _payload(0.3),
+                        "--gate", "value:0.5")
+        assert bad.returncode == 3
+        assert "REGRESSION value" in bad.stderr
+
+    def test_lower_is_better_direction(self, tmp_path):
+        # p99 doubled (0.5x factor): regression on a lower-is-better rung
+        old, new = _payload(1.0), _payload(1.0)
+        new["serving"]["latency_p99_ms"] = 10.0
+        bad = self._run(tmp_path, old, new, "--gate", "serving.latency_p99_ms:0.8")
+        assert bad.returncode == 3
+
+    def test_schema_drift_refused(self, tmp_path):
+        proc = self._run(tmp_path, _payload(schema=1), _payload(schema=2))
+        assert proc.returncode == 2
+        assert "obs_schema drift" in proc.stderr
+        proc = self._run(tmp_path, _payload(schema=1), _payload(schema=2),
+                         "--allow-schema-drift")
+        assert proc.returncode == 0
+
+    def test_malformed_and_missing_rung_fail(self, tmp_path):
+        p = str(tmp_path / "junk.json")
+        open(p, "w").write("not json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             p, p], capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        old, new = _payload(), _payload()
+        del new["serving"]
+        proc = self._run(tmp_path, old, new, "--gate", "serving.qps:0.5")
+        assert proc.returncode == 1
+        assert "missing" in proc.stderr
+
+    def test_wrapper_and_tail_fallback(self, tmp_path):
+        wrapped_old = {"n": 1, "rc": 0, "parsed": _payload(1.0)}
+        wrapped_new = {
+            "n": 2, "rc": 0, "parsed": {},
+            "tail": "noise\n" + json.dumps(_payload(2.0)) + "\n",
+        }
+        proc = self._run(tmp_path, wrapped_old, wrapped_new)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_module_api_loads(self):
+        bd = _load_tool("bench_diff")
+        assert bd.regression_factor("value", 1.0, 2.0) == 2.0
+        assert bd.regression_factor("wall_s", 1.0, 2.0) == 0.5
+        assert bd.regression_factor("value", 0.0, 0.0) == 1.0
+        assert bd.regression_factor("value", 0.0, 1.0) is None
+
+
+# -----------------------------------------------------------------------------
+# schema registry drift guard
+# -----------------------------------------------------------------------------
+
+
+class TestHelpRegistry:
+    def test_clean_on_real_schema(self):
+        check_mod = _load_tool("check_obs_schema")
+        assert check_mod.check_help_registry() == []
+
+    def test_detects_drift(self, monkeypatch):
+        from consensusclustr_tpu.obs import schema as obs_schema
+
+        check_mod = _load_tool("check_obs_schema")
+        broken = dict(obs_schema.METRIC_HELP)
+        broken.pop("queue_depth")
+        broken["never_registered"] = "orphan help"
+        monkeypatch.setattr(check_mod.schema, "METRIC_HELP", broken)
+        errors = check_mod.check_help_registry()
+        assert any("queue_depth" in e for e in errors)
+        assert any("never_registered" in e for e in errors)
+
+    def test_schema_version_bumped_for_bucket_fields(self):
+        assert SCHEMA_VERSION >= 2

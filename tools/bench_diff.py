@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff two bench JSON payloads (BENCH_*.json).
+
+Usage:
+    python tools/bench_diff.py OLD.json NEW.json        # delta table
+    python tools/bench_diff.py --latest [--dir ROOT]    # newest committed pair
+    python tools/bench_diff.py --check  [--dir ROOT]    # structural gate (CI)
+    python tools/bench_diff.py OLD NEW --gate value:0.5 --gate serving.qps:0.5
+
+Inputs are either the driver wrapper shape committed at the repo root
+({"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {bench line}}) or a raw
+bench.py JSON line; the payload is the bench line itself. A wrapper whose
+``parsed`` is empty falls back to the last JSON object in ``tail`` (rounds
+where the driver captured output but did not parse it).
+
+Contracts:
+
+  * **schema fence** — payloads stamped with different ``obs_schema`` versions
+    (missing = 0, the pre-obs era) refuse to diff: phase breakdowns and
+    histogram fields are not comparable across schema bumps. Override with
+    --allow-schema-drift when you know the rungs you gate on are unaffected.
+  * **named-rung gates** — ``--gate RUNG:MIN_FACTOR`` computes a regression
+    factor per rung (new/old for higher-is-better rungs, old/new for
+    lower-is-better like latency; the direction registry is RUNGS below) and
+    exits nonzero when any factor drops under MIN_FACTOR. A gated rung missing
+    from either payload is itself a failure — silence must not pass a gate.
+  * **--check** — the tier-1 hook: resolve the newest BENCH_*.json pair,
+    parse both payloads, enforce the schema fence and payload well-formedness
+    ("metric"/"value"/"unit" present), print the delta table. Exits nonzero
+    on malformed/missing payloads or schema drift; it does NOT gate on
+    performance (committed CPU-fallback rounds are too noisy for that — gate
+    explicitly on accelerator rounds instead).
+
+Exit codes: 0 clean; 1 malformed input / missing rung; 2 schema drift;
+3 gated regression. Standalone: stdlib-only, no package import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Rung name -> direction: +1 = higher is better, -1 = lower is better.
+# Dotted names index into nested payload dicts (the serving rung).
+RUNGS: Dict[str, int] = {
+    "value": +1,
+    "vs_baseline": +1,
+    "boots_per_sec": +1,
+    "overlap_ratio": +1,
+    "wall_s": -1,
+    "serving.qps": +1,
+    "serving.cells_per_sec": +1,
+    "serving.latency_p50_ms": -1,
+    "serving.latency_p99_ms": -1,
+    "serving.bucket_compiles": -1,
+}
+
+_JSON_LINE = re.compile(r"^\{.*\}$")
+
+
+class BenchDiffError(SystemExit):
+    def __init__(self, code: int, message: str) -> None:
+        print(f"bench_diff: {message}", file=sys.stderr)
+        super().__init__(code)
+
+
+def load_payload(path: str) -> dict:
+    """The bench JSON line inside ``path`` (wrapper or raw); loud on junk."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise BenchDiffError(1, f"{path}: unreadable bench JSON ({e})")
+    if not isinstance(doc, dict):
+        raise BenchDiffError(1, f"{path}: expected a JSON object")
+    if "parsed" in doc:  # driver wrapper
+        payload = doc.get("parsed")
+        if not payload:
+            payload = _payload_from_tail(doc.get("tail", ""))
+        if not payload:
+            raise BenchDiffError(
+                1, f"{path}: wrapper has empty 'parsed' and no JSON line in "
+                   "'tail' (failed round?)"
+            )
+    else:
+        payload = doc
+    for key in ("metric", "value", "unit"):
+        if key not in payload:
+            raise BenchDiffError(
+                1, f"{path}: bench payload missing required key {key!r}"
+            )
+    return payload
+
+
+def _payload_from_tail(tail: str) -> Optional[dict]:
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if _JSON_LINE.match(line):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+    return None
+
+
+def newest_pair(root: str) -> Tuple[str, str]:
+    """The two lexicographically newest BENCH_*.json files under ``root``
+    (the driver numbers rounds r01, r02, ... so name order is round order)."""
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    if len(paths) < 2:
+        raise BenchDiffError(
+            1, f"{root}: need >= 2 BENCH_*.json files, found {len(paths)}"
+        )
+    return paths[-2], paths[-1]
+
+
+def rung_value(payload: dict, rung: str) -> Optional[float]:
+    cur: object = payload
+    for part in rung.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        return None
+
+
+def regression_factor(rung: str, old: float, new: float) -> Optional[float]:
+    """Factor < 1 means NEW is worse on this rung; None when undefined
+    (zero denominator — e.g. a failed round's 0.0 rung)."""
+    direction = RUNGS.get(rung, +1)
+    num, den = (new, old) if direction > 0 else (old, new)
+    if den == 0.0:
+        return 1.0 if num == 0.0 else None
+    return num / den
+
+
+def schema_of(payload: dict) -> int:
+    return int(payload.get("obs_schema", 0))
+
+
+def diff_table(old: dict, new: dict) -> str:
+    lines = [f"{'rung':<28} {'old':>12} {'new':>12} {'factor':>8}  dir"]
+    for rung, direction in RUNGS.items():
+        ov, nv = rung_value(old, rung), rung_value(new, rung)
+        if ov is None and nv is None:
+            continue
+        factor = (
+            regression_factor(rung, ov, nv)
+            if ov is not None and nv is not None
+            else None
+        )
+        lines.append(
+            f"{rung:<28} "
+            f"{ov if ov is not None else '-':>12} "
+            f"{nv if nv is not None else '-':>12} "
+            f"{f'{factor:.3f}' if factor is not None else '-':>8}  "
+            f"{'^' if direction > 0 else 'v'}"
+        )
+    return "\n".join(lines)
+
+
+def parse_gates(specs: List[str]) -> List[Tuple[str, float]]:
+    gates = []
+    for spec in specs:
+        rung, sep, thresh = spec.partition(":")
+        if not sep:
+            raise BenchDiffError(1, f"--gate expects RUNG:MIN_FACTOR; got {spec!r}")
+        if rung not in RUNGS:
+            raise BenchDiffError(
+                1, f"--gate names unknown rung {rung!r} "
+                   f"(known: {', '.join(sorted(RUNGS))})"
+            )
+        try:
+            gates.append((rung, float(thresh)))
+        except ValueError:
+            raise BenchDiffError(1, f"--gate threshold not a number: {spec!r}")
+    return gates
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", nargs="?", help="older bench JSON file")
+    ap.add_argument("new", nargs="?", help="newer bench JSON file")
+    ap.add_argument("--latest", action="store_true",
+                    help="diff the newest BENCH_*.json pair under --dir")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: newest pair, structural validation only")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding BENCH_*.json (default: this repo)")
+    ap.add_argument("--gate", action="append", default=[], metavar="RUNG:MIN",
+                    help="fail (exit 3) when RUNG's regression factor < MIN; "
+                         "repeatable")
+    ap.add_argument("--allow-schema-drift", action="store_true",
+                    help="diff payloads despite differing obs_schema stamps")
+    args = ap.parse_args(argv)
+
+    if args.check or args.latest:
+        if args.old or args.new:
+            raise BenchDiffError(1, "--check/--latest take no file arguments")
+        old_path, new_path = newest_pair(args.dir)
+    elif args.old and args.new:
+        old_path, new_path = args.old, args.new
+    else:
+        ap.print_usage(sys.stderr)
+        raise BenchDiffError(1, "need OLD and NEW files, or --latest/--check")
+
+    old, new = load_payload(old_path), load_payload(new_path)
+    s_old, s_new = schema_of(old), schema_of(new)
+    print(f"old: {old_path} (obs_schema={s_old}) -- {old.get('metric')}")
+    print(f"new: {new_path} (obs_schema={s_new}) -- {new.get('metric')}")
+    if s_old != s_new and not args.allow_schema_drift:
+        raise BenchDiffError(
+            2, f"obs_schema drift ({s_old} -> {s_new}): refusing to compare "
+               "(--allow-schema-drift to override)"
+        )
+    print(diff_table(old, new))
+
+    failures = []
+    for rung, min_factor in parse_gates(args.gate):
+        ov, nv = rung_value(old, rung), rung_value(new, rung)
+        if ov is None or nv is None:
+            raise BenchDiffError(
+                1, f"gated rung {rung!r} missing from "
+                   f"{'old' if ov is None else 'new'} payload"
+            )
+        factor = regression_factor(rung, ov, nv)
+        if factor is None:
+            raise BenchDiffError(
+                1, f"gated rung {rung!r} has a zero denominator "
+                   f"(old={ov} new={nv}): factor undefined"
+            )
+        if factor < min_factor:
+            failures.append(f"{rung}: factor {factor:.3f} < {min_factor} "
+                            f"(old={ov} new={nv})")
+    if failures:
+        for f in failures:
+            print(f"REGRESSION {f}", file=sys.stderr)
+        return 3
+    print("bench_diff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

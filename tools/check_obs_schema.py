@@ -6,13 +6,21 @@ Scans the package sources (and bench.py) for literal event/span/metric names:
     log.event("boots", ...)          -> obs.schema.EVENT_KINDS
     tracer.span("cocluster")         -> obs.schema.SPAN_NAMES
     maybe_span(log, "null_test")     -> obs.schema.SPAN_NAMES
-    metrics.counter("boots_completed") / .gauge / .histogram
+    metrics.counter("boots_completed") / .gauge("queue_depth")
+        / .histogram("serve_latency_seconds")
                                      -> obs.schema.METRIC_NAMES
 
 and fails on any name missing from the registry — a typo'd metric name
-becomes a test failure instead of a silently absent time series. Dynamic
+becomes a test failure instead of a silently absent time series. All three
+instrument kinds (counter/gauge/histogram literals) are scanned; the package
+walk covers every subpackage including obs/export.py and serve/. Dynamic
 (non-literal) names are out of scope by design; the registry covers the
 package's own instrumentation, which is all literal.
+
+Since ISSUE 4 the registry also carries per-metric help text
+(``obs.schema.METRIC_HELP`` — the Prometheus # HELP lines): this check fails
+when METRIC_HELP and METRIC_NAMES drift apart, so every exported series is
+documented and no documented series is unregistered.
 
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
@@ -64,9 +72,32 @@ def _py_files(root: str) -> List[str]:
     return sorted(out)
 
 
+def check_help_registry() -> List[str]:
+    """METRIC_HELP <-> METRIC_NAMES consistency (the Prometheus # HELP
+    contract): every registered metric documented, every help entry
+    registered."""
+    errors: List[str] = []
+    help_map = getattr(schema, "METRIC_HELP", None)
+    if help_map is None:
+        return ["obs/schema.py: METRIC_HELP registry is missing"]
+    for name in sorted(schema.METRIC_NAMES - set(help_map)):
+        errors.append(
+            f"obs/schema.py: metric {name!r} registered without METRIC_HELP "
+            "text (Prometheus # HELP would be empty)"
+        )
+    for name in sorted(set(help_map) - schema.METRIC_NAMES):
+        errors.append(
+            f"obs/schema.py: METRIC_HELP entry {name!r} not in METRIC_NAMES"
+        )
+    for name, text in sorted(help_map.items()):
+        if not str(text).strip():
+            errors.append(f"obs/schema.py: METRIC_HELP for {name!r} is empty")
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
-    errors: List[str] = []
+    errors: List[str] = check_help_registry()
     for path in _py_files(root):
         rel = os.path.relpath(path, root)
         with open(path, encoding="utf-8") as f:

@@ -5,25 +5,46 @@ Usage:
     python tools/report.py RUN_RECORD.jsonl            # last record
     python tools/report.py RUN_RECORD.jsonl --index 0  # first record
     python tools/report.py RUN_RECORD.jsonl --all      # every record
+    python tools/report.py RUN_RECORD.jsonl --trace out.json
+        # ^ additionally export the record as Chrome trace-event JSON —
+        #   open out.json in ui.perfetto.dev (docs/perf.md "Exporting a trace")
 
 Produces: a per-phase table (top-level spans, seconds, % of wall), a
 flamegraph-style text rendering of the span tree, error events, and the
-metrics snapshot.
+metrics snapshot (bucketed histograms render p50/p99 estimates).
 
 Deliberately standalone — parses the schema-versioned JSON directly, no
 package (or jax) import, so it runs anywhere a record file lands (including
-hosts without the accelerator stack).
+hosts without the accelerator stack). The --trace / quantile paths load
+``consensusclustr_tpu/obs/export.py`` by file path (it is stdlib-only); when
+this script is copied off-repo without that file, everything else still works.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1,)
+KNOWN_SCHEMAS = (1, 2)
 BAR_WIDTH = 24
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _export_mod():
+    """obs/export.py loaded by path (stdlib-only); None when unavailable."""
+    import importlib.util
+
+    path = os.path.join(_ROOT, "consensusclustr_tpu", "obs", "export.py")
+    if not os.path.isfile(path):
+        return None
+    spec = importlib.util.spec_from_file_location("_cctpu_obs_export", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load(path: str) -> List[dict]:
@@ -162,6 +183,16 @@ def serving(record: dict) -> str:
         v = hist.get(stat)
         if v is not None:
             lines.append(f"{'latency ' + stat + ' (ms)':<28} {1000.0 * v:.3f}")
+    exp = _export_mod()
+    if exp is not None:
+        # schema >= 2 records carry bucket counts; estimate the quantiles an
+        # operator actually watches (same estimator as the /metrics endpoint)
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            v = exp.prom_quantile(hist, q)
+            if v is not None:
+                lines.append(
+                    f"{'latency ' + label + ' (ms, est)':<28} {1000.0 * v:.3f}"
+                )
     for label, key in (
         ("bucket compiles", "serve_compile"),
         ("rejections", "serve_rejections"),
@@ -223,6 +254,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--index", type=int, default=-1,
                     help="which record to render (default: last)")
     ap.add_argument("--all", action="store_true", help="render every record")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="also export the selected record as Chrome "
+                         "trace-event JSON (load in ui.perfetto.dev)")
     args = ap.parse_args(argv)
     records = load(args.path)
     picked = records if args.all else [records[args.index]]
@@ -231,6 +265,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if len(picked) > 1:
             out.append(f"--- record {i} ---")
         out.append(render(rec))
+    if args.trace:
+        exp = _export_mod()
+        if exp is None:
+            raise SystemExit(
+                "--trace needs consensusclustr_tpu/obs/export.py next to this "
+                "script (stdlib-only; no package install required)"
+            )
+        rec = picked[-1]
+        exp.write_chrome_trace(
+            args.trace, rec.get("spans", []), rec.get("events", []),
+            metadata={
+                "schema": rec.get("schema"), "backend": rec.get("backend"),
+                "config_fingerprint": rec.get("config_fingerprint"),
+                "wall_s": rec.get("wall_s"),
+            },
+        )
+        out.append(f"trace -> {args.trace} (open in ui.perfetto.dev)")
     print("\n".join(out))
     return 0
 

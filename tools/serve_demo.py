@@ -6,6 +6,7 @@ reference-mapping story in one command.
     python tools/serve_demo.py --cells 1000 --queries 500
     python tools/serve_demo.py --bundle /tmp/ref --keep-bundle
     python tools/serve_demo.py --record serve_run.jsonl   # -> tools/report.py
+    python tools/serve_demo.py --metrics-port 9109        # live /metrics scrape
 
 Steps (each printed as it runs):
 
@@ -17,8 +18,11 @@ Steps (each printed as it runs):
                 backpressure;
   5. verify   — the reference's own cells assigned back: must reproduce the
                 offline labels exactly (the self-assignment parity contract);
-  6. report   — qps, latency p50/p99, bucket compiles, and optionally the
-                service RunRecord for tools/report.py's "== serving ==" table.
+  6. report   — qps, latency p50/p99 (from the service's bucketed
+                ``serve_latency_seconds`` histogram — the same estimates
+                bench.py and the /metrics endpoint report), bucket compiles,
+                and optionally the service RunRecord for tools/report.py's
+                "== serving ==" table.
 """
 
 from __future__ import annotations
@@ -52,6 +56,9 @@ def main(argv=None) -> int:
     ap.add_argument("--keep-bundle", action="store_true")
     ap.add_argument("--record", default=None,
                     help="append the service RunRecord JSONL here")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics + /healthz on this port "
+                         "while the demo runs (0 = ephemeral; default off)")
     args = ap.parse_args(argv)
 
     from consensusclustr_tpu.api import consensus_clust, export_reference
@@ -91,22 +98,24 @@ def main(argv=None) -> int:
     queries = [
         counts[rng.integers(0, args.cells, int(s))] for s in sizes
     ]
-    lat = []
-    with AssignmentService(art, max_batch=args.max_batch) as svc:
+    with AssignmentService(
+        art, max_batch=args.max_batch, metrics_port=args.metrics_port
+    ) as svc:
         print(f"      buckets={svc.buckets} compiles={svc.bucket_compiles}")
+        if svc.metrics_port is not None:
+            print(f"      scrape: curl http://127.0.0.1:{svc.metrics_port}"
+                  "/metrics  (/healthz for drain state)")
         t0 = time.perf_counter()
         futs = []
         for q in queries:
-            t_sub = time.perf_counter()
             while True:
                 try:
-                    futs.append((t_sub, svc.submit(q)))
+                    futs.append(svc.submit(q))
                     break
                 except RetryableRejection:
                     time.sleep(0.001)
-        for t_sub, f in futs:
+        for f in futs:
             f.result(timeout=300)
-            lat.append(time.perf_counter() - t_sub)
         wall = time.perf_counter() - t0
 
         print("[5/6] verify: self-assignment parity")
@@ -120,12 +129,16 @@ def main(argv=None) -> int:
         print(f"      exact={exact} "
               f"min_confidence={float(back.confidence.min()):.3f}")
 
-        lat_ms = np.sort(np.asarray(lat)) * 1000.0
+        # the same bucketed-histogram estimates bench.py's serving rung and
+        # the /metrics endpoint report (ISSUE 4: one latency number per fact)
+        hist = svc.metrics.histogram("serve_latency_seconds")
+        p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
         print("[6/6] report")
         print(f"      requests={len(queries)} qps={len(queries) / wall:.1f} "
               f"cells/s={sizes.sum() / wall:.0f}")
-        print(f"      latency p50={np.percentile(lat_ms, 50):.2f}ms "
-              f"p99={np.percentile(lat_ms, 99):.2f}ms")
+        print(f"      latency p50={1000.0 * (p50 or 0.0):.2f}ms "
+              f"p99={1000.0 * (p99 or 0.0):.2f}ms "
+              f"(bucketed estimate, n={hist.count})")
         print(f"      bucket_compiles={svc.bucket_compiles} "
               f"(buckets reused across {len(queries)} request sizes)")
         if args.record:

@@ -23,7 +23,16 @@ always emits the JSON line. Failure ladder:
   4. Anything else -> JSON line with value 0.0 and the error message.
 
 Env knobs: BENCH_CELLS, BENCH_BOOTS, BENCH_RES, BENCH_PCS (defaults scale with
-the backend: accelerator vs CPU smoke).
+the backend: accelerator vs CPU smoke). CCTPU_BENCH_PROBE_BUDGET bounds the
+backend-probe retry window (seconds, default 240; legacy
+BENCH_PROBE_BUDGET_SECS honored); the probe verdict is cached per process and
+its cost is reported as ``probe_s`` on every rung, separate from ``wall_s``.
+
+Dispatch accounting (obs schema v3): every rung also carries
+``device_dispatches`` / ``executable_compiles`` / ``donated_bytes`` — deltas
+of the counting_jit counters (utils/compile_cache.py) across the rung, so
+tools/bench_diff.py can gate on program-count regressions
+(``--gate compiles:...``), not just boots/s.
 """
 
 from __future__ import annotations
@@ -59,6 +68,37 @@ if os.environ.get("CCTPU_FORCE_CPU"):
 
 NORTH_STAR_BOOTS_PER_SEC = 1000.0 / 60.0
 _RETRY_FLAG = "CCTPU_BENCH_CPU_RETRY"
+
+# Process-cached backend-probe verdict (ISSUE 5 satellite): the probe is paid
+# at most once per process; its outcome and wall cost are carried separately
+# from the measured run (``probe`` / ``probe_s`` payload keys) so wall_s no
+# longer silently absorbs up to the whole probe budget (r4's 22.3 s vs r5's
+# 6.3 s was mostly probe noise). The CPU-retry subprocess inherits the
+# verdict through CCTPU_BENCH_PROBE_* so it never re-probes either.
+_PROBE_CACHE: dict = {}
+
+_DISPATCH_KEYS = ("device_dispatches", "executable_compiles", "donated_bytes")
+
+
+def _dispatch_counters() -> dict:
+    """Current process-global dispatch-accounting counters (obs schema v3;
+    sourced by utils/compile_cache.counting_jit). Guarded: the failure rung
+    must emit even when the package cannot import."""
+    out = {k: 0 for k in _DISPATCH_KEYS}
+    try:
+        from consensusclustr_tpu.obs import global_metrics
+
+        counters = global_metrics().counters
+        for k in _DISPATCH_KEYS:
+            if k in counters:
+                out[k] = int(counters[k].value)
+    except Exception:
+        pass
+    return out
+
+
+def _dispatch_delta(before: dict, after: dict) -> dict:
+    return {k: max(0, after.get(k, 0) - before.get(k, 0)) for k in _DISPATCH_KEYS}
 
 # The serving rung's zero shape — emitted verbatim on the failure rung so
 # BENCH_*.json lines stay key-comparable across PRs.
@@ -327,7 +367,11 @@ def _run() -> dict:
     from consensusclustr_tpu.consensus import cocluster as cocluster_mod
     from consensusclustr_tpu.obs import Tracer
     from consensusclustr_tpu.ops import pallas_cocluster as _pallas_mod
-    from consensusclustr_tpu.consensus.cocluster import coclustering_distance
+    from consensusclustr_tpu.consensus.cocluster import (
+        CoclusterAccumulator,
+        _pallas_wanted,
+        coclustering_distance,
+    )
     from consensusclustr_tpu.consensus.pipeline import run_bootstraps
     from consensusclustr_tpu.utils.log import LevelLog
     from consensusclustr_tpu.utils.rng import root_key
@@ -352,16 +396,29 @@ def _run() -> dict:
     key = root_key(123)
     pca_dev = jnp.asarray(pca)
 
+    # Mirror the production dense dispatch (consensus/pipeline.py): the
+    # einsum regime streams counts through the donated accumulator during the
+    # boot loop (bit-identical to the one-shot pass; exercises donated_bytes);
+    # the Pallas regime keeps the one-shot tiled kernel so TPU rounds still
+    # measure (and parity-check) the kernel itself.
+    streamed = not _pallas_wanted(cfg.use_pallas, cfg.max_clusters)
+
     def run(tracer):
         # spans cover the whole timed region: "boots" opens inside
         # run_bootstraps, "cocluster" here — so the emitted phases dict
         # accounts for (within rounding) all of wall_s
-        labels, _ = run_bootstraps(key, pca_dev, cfg, LevelLog(tracer=tracer))
+        acc = CoclusterAccumulator(n, cfg.max_clusters) if streamed else None
+        labels, _ = run_bootstraps(
+            key, pca_dev, cfg, LevelLog(tracer=tracer), accumulator=acc
+        )
         with tracer.span("cocluster") as sp:
-            dist = coclustering_distance(
-                jnp.asarray(labels, jnp.int32), cfg.max_clusters,
-                use_pallas=cfg.use_pallas,
-            )
+            if acc is not None:
+                dist = acc.distance()
+            else:
+                dist = coclustering_distance(
+                    jnp.asarray(labels, jnp.int32), cfg.max_clusters,
+                    use_pallas=cfg.use_pallas,
+                )
             sp.value = dist
         return jax.block_until_ready(dist)
 
@@ -449,33 +506,73 @@ def _alarm(seconds: int) -> None:
         pass  # no SIGALRM on this platform; the probe + retry still bound us
 
 
+def _probe_budget_secs() -> int:
+    """Probe-budget resolution: ``CCTPU_BENCH_PROBE_BUDGET`` wins, the legacy
+    ``BENCH_PROBE_BUDGET_SECS`` is still honored, default 240 s — well under
+    the old 900 s budget whose worst case (plus the 120 s subprocess timeout)
+    burned 1020 s per round before any measurement started (r4/r5)."""
+    for var in ("CCTPU_BENCH_PROBE_BUDGET", "BENCH_PROBE_BUDGET_SECS"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                sys.stderr.write(f"bench: ignoring non-integer {var}={v!r}\n")
+    return 240
+
+
 def _await_healthy_backend() -> str:
     """Healthy-window retry (VERDICT r3 next #1a): a flaky serving tunnel can
     wedge and recover; one failed probe should not forfeit the round's only
     accelerator measurement. Re-probe every BENCH_PROBE_INTERVAL_SECS up to
-    BENCH_PROBE_BUDGET_SECS before giving up. Returns the probe outcome
-    string recorded in the bench JSON."""
-    budget = int(os.environ.get("BENCH_PROBE_BUDGET_SECS", "900"))
+    the probe budget (``_probe_budget_secs``) before giving up. The verdict
+    and its wall cost are cached for the process (``_PROBE_CACHE``) — repeat
+    calls return the cached outcome without touching the backend. Returns the
+    probe outcome string recorded in the bench JSON."""
+    if "outcome" in _PROBE_CACHE:
+        return _PROBE_CACHE["outcome"]
+    # a parent bench process (CPU-retry re-exec) already paid the probe
+    inherited = os.environ.get("CCTPU_BENCH_PROBE_VERDICT")
+    if inherited:
+        _PROBE_CACHE.setdefault("outcome", inherited)
+        _PROBE_CACHE.setdefault(
+            "seconds", float(os.environ.get("CCTPU_BENCH_PROBE_S", 0) or 0)
+        )
+        return inherited
+    budget = _probe_budget_secs()
     interval = int(os.environ.get("BENCH_PROBE_INTERVAL_SECS", "120"))
     t0 = time.time()
     first = True
-    while True:
+    outcome = None
+    while outcome is None:
         if _backend_probe_ok():
             waited = time.time() - t0
-            return "healthy" if first else f"healthy_after_{waited:.0f}s"
+            outcome = "healthy" if first else f"healthy_after_{waited:.0f}s"
+            break
         first = False
         remaining = budget - (time.time() - t0)
         if remaining <= 0:
-            return f"cpu_forced_after_{time.time() - t0:.0f}s"
+            outcome = f"cpu_forced_after_{time.time() - t0:.0f}s"
+            break
         sys.stderr.write(
             f"bench: backend unresponsive; re-probing ({remaining:.0f}s of "
             "probe budget left)\n"
         )
         time.sleep(min(interval, max(remaining, 1)))
+    _PROBE_CACHE["outcome"] = outcome
+    _PROBE_CACHE["seconds"] = round(time.time() - t0, 3)
+    return outcome
 
 
 def main() -> None:
-    probe_outcome = None
+    # a parent bench process may have probed already (CPU-retry re-exec):
+    # inherit its verdict and cost so this process reports them instead of 0
+    probe_outcome = os.environ.get("CCTPU_BENCH_PROBE_VERDICT") or None
+    if probe_outcome is not None:
+        _PROBE_CACHE.setdefault("outcome", probe_outcome)
+        _PROBE_CACHE.setdefault(
+            "seconds", float(os.environ.get("CCTPU_BENCH_PROBE_S", 0) or 0)
+        )
     if (
         not os.environ.get(_RETRY_FLAG)
         and not os.environ.get("CCTPU_FORCE_CPU")
@@ -495,13 +592,19 @@ def main() -> None:
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 pass
+    probe_s = round(float(_PROBE_CACHE.get("seconds", 0.0)), 3)
     # second line of defense for mid-run stalls (only fires when the
     # interpreter regains control between ops)
     _alarm(int(os.environ.get("BENCH_WATCHDOG_SECS", "1500")))
+    dispatch0 = _dispatch_counters()
     try:
         payload = _run()
         if probe_outcome is not None:
             payload["probe"] = probe_outcome
+        # probe time is reported SEPARATELY from the measured run: wall_s /
+        # value describe the workload, probe_s the environment's health check
+        payload["probe_s"] = probe_s
+        payload.update(_dispatch_delta(dispatch0, _dispatch_counters()))
         _emit(payload)
         _alarm(0)
         return
@@ -519,6 +622,11 @@ def main() -> None:
     ):
         sys.stderr.write("bench: retrying on CPU backend\n")
         env = dict(os.environ, CCTPU_FORCE_CPU="1", **{_RETRY_FLAG: "1"})
+        if probe_outcome is not None:
+            # hand the cached probe verdict + cost down so the retry process
+            # neither re-probes nor loses the probe_s accounting
+            env["CCTPU_BENCH_PROBE_VERDICT"] = probe_outcome
+            env["CCTPU_BENCH_PROBE_S"] = str(probe_s)
         for k in list(env):
             if k.startswith("BENCH_"):  # smoke shapes, not the accel workload
                 del env[k]
@@ -550,6 +658,8 @@ def main() -> None:
             "pipeline_depth": _pipeline_depth(),
             "overlap_ratio": 0.0,
             "serving": dict(_SERVING_ZERO),
+            "probe_s": probe_s,
+            **_dispatch_delta(dispatch0, _dispatch_counters()),
             "obs_schema": _OBS_SCHEMA,
         }
     )

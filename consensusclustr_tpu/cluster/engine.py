@@ -117,6 +117,28 @@ def community_detect(
     return leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
 
 
+def _grid_one_k(
+    key, x, idx_max, res_list, ki, kv, min_size, max_clusters, n_iters,
+    update_frac, cluster_fun,
+):
+    """One k of the candidate grid: masked SNN build + Leiden/Louvain vmapped
+    over the resolution axis. ``ki``/``kv`` may be traced (the fused grid
+    vmaps this over the k axis) or concrete (the looped parity oracle)."""
+    r = res_list.shape[0]
+    graph = snn_graph(idx_max, k=kv)
+    keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
+
+    def one_res(kk, res):
+        raw = community_detect(
+            kk, graph, res, cluster_fun, n_iters=n_iters, update_frac=update_frac
+        )
+        compact, n_c, overflow = compact_labels(raw, max_clusters)
+        score = candidate_score(x, compact, n_c, overflow, min_size, max_clusters)
+        return compact, n_c, score
+
+    return jax.vmap(one_res)(keys, res_list)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -136,37 +158,81 @@ def cluster_grid(
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
 ) -> GridResult:
-    """All (k, resolution) candidates for one [m, d] point set.
+    """All (k, resolution) candidates for one [m, d] point set, as ONE fused
+    program over the full [K, R] grid.
 
     The kNN distance pass — the dominant per-boot FLOP cost at scale (the
     [m, m] MXU matmul + top_k) — runs ONCE at max(k_list): top-k neighbour
     lists are prefix-nested (lax.top_k is deterministic with ties to the
     lower index, and the degenerate-n padding repeats the same last true
     column), so idx_kmax[:, :k] is bit-identical to a direct k-NN call
-    (asserted in tests/test_cluster.py). The SNN graph is then built once
-    per k (it does not depend on resolution); Leiden/Louvain is vmapped over
-    the resolution axis — the reference instead runs 6000 sequential igraph
-    calls per level (SURVEY §3.1 hot loop #1).
+    (asserted in tests/test_cluster.py). The SNN build is mask-based over the
+    padded [m, k_max] neighbour tensor (cluster/snn.py), so the k axis vmaps
+    instead of unrolling: the emitted program holds ONE copy of the
+    SNN + Leiden machinery rather than |k_list| (smaller HLO, faster compile,
+    one fused batched sweep on device). The reference instead runs 6000
+    sequential igraph calls per level (SURVEY §3.1 hot loop #1).
+
+    Bit-parity contract: identical outputs to ``cluster_grid_looped`` (the
+    per-k Python-loop form, kept as the parity oracle) — pinned by
+    tests/test_fused_grid.py.
     """
     x = jnp.asarray(x, jnp.float32)
     res_list = jnp.asarray(res_list, jnp.float32)
     r = res_list.shape[0]
+    n_k = len(k_list)
+
+    idx_max, _ = knn_points(x, max(k_list), compute_dtype=compute_dtype)
+    labels, nc, scores = jax.vmap(
+        lambda ki, kv: _grid_one_k(
+            key, x, idx_max, res_list, ki, kv, min_size, max_clusters,
+            n_iters, update_frac, cluster_fun,
+        )
+    )(jnp.arange(n_k, dtype=jnp.int32), jnp.asarray(k_list, jnp.int32))
+
+    # [K, R, ...] -> [K*R, ...] in the same k-major order the old per-k
+    # concatenates produced
+    return GridResult(
+        labels=labels.reshape(n_k * r, -1),
+        n_clusters=nc.reshape(n_k * r),
+        scores=scores.reshape(n_k * r),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
+        "compute_dtype",
+    ),
+)
+def cluster_grid_looped(
+    key: jax.Array,
+    x: jax.Array,
+    res_list: jax.Array,
+    k_list: Tuple[int, ...],
+    min_size: jax.Array,
+    max_clusters: int = 64,
+    n_iters: int = DEFAULT_COMMUNITY_ITERS,
+    update_frac: float = 0.5,
+    cluster_fun: str = "leiden",
+    compute_dtype: str = "float32",
+) -> GridResult:
+    """Parity oracle for the fused ``cluster_grid``: the historical per-k
+    Python loop (one SNN build + one vmapped res sweep per k, concatenated),
+    sharing ``_grid_one_k`` with the fused path so the only difference under
+    test is loop-unrolled vs vmapped k. Not a production path — the fused
+    grid must match it bit for bit (tests/test_fused_grid.py)."""
+    x = jnp.asarray(x, jnp.float32)
+    res_list = jnp.asarray(res_list, jnp.float32)
 
     idx_max, _ = knn_points(x, max(k_list), compute_dtype=compute_dtype)
     all_labels, all_nc, all_scores = [], [], []
     for ki, k in enumerate(k_list):
-        graph = snn_graph(idx_max[:, :k])
-        keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
-
-        def one_res(kk, res):
-            raw = community_detect(
-                kk, graph, res, cluster_fun, n_iters=n_iters, update_frac=update_frac
-            )
-            compact, n_c, overflow = compact_labels(raw, max_clusters)
-            score = candidate_score(x, compact, n_c, overflow, min_size, max_clusters)
-            return compact, n_c, score
-
-        labels_k, nc_k, scores_k = jax.vmap(one_res)(keys, res_list)
+        labels_k, nc_k, scores_k = _grid_one_k(
+            key, x, idx_max, res_list, ki, jnp.int32(k), min_size,
+            max_clusters, n_iters, update_frac, cluster_fun,
+        )
         all_labels.append(labels_k)
         all_nc.append(nc_k)
         all_scores.append(scores_k)

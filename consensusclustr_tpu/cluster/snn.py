@@ -15,12 +15,21 @@ k out-edges — a dense [n, k] slot layout.
 The graph is symmetrised into [n, 2k] edge slots: slots 0..k-1 are out-edges,
 slots k..2k-1 carry the reverse of non-mutual out-edges (mutual pairs would
 otherwise be double-counted; the rank weight is symmetric so dedup is a mask).
+
+Mask-based k (ISSUE 5 tentpole): ``snn_graph(idx, k=kv)`` builds the graph of
+the first ``kv`` neighbour columns of a padded [n, k_max] index tensor with
+``kv`` a *traced* value — slot layout stays [n, 2*k_max] with invalid slots
+inert (nbr = self id, w = 0). Because the shape no longer depends on k, the
+whole k sweep of ``cluster_grid`` vmaps into one program instead of unrolling
+one SNN build + Leiden sweep per k. Weights, degrees and two_m of the valid
+slots are bit-identical to the sliced build (the rank weights are dyadic
+rationals ≤ k, so their sums are exact in f32 under any reduction order).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -69,23 +78,49 @@ def _rank_weights(idx: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def snn_graph(idx: jax.Array) -> SNNGraph:
-    """Build the symmetric rank-weighted SNN graph from kNN indices [n, k].
+def _rank_weights_masked(idx: jax.Array, kv: jax.Array) -> jax.Array:
+    """_rank_weights over the first ``kv`` columns of a padded [n, k_max]
+    index tensor; columns >= kv weigh 0. Bit-identical in the valid columns
+    to ``_rank_weights(idx[:, :kv])``: the masked entries enter the min as
+    +inf and every step with q > kv leaves the carry untouched, so the same
+    (p, q) pairs survive."""
+    n, k_max = idx.shape
+    kv = jnp.asarray(kv, jnp.int32)
+    colv = jnp.arange(k_max, dtype=jnp.int32) < kv            # [k_max]
+    self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
+    lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k_max+1]
+    pranks = jnp.arange(k_max + 1, dtype=jnp.float32)
+    # list position p is valid iff p == 0 (self) or column p-1 < kv
+    pvalid = jnp.concatenate([jnp.array([True]), colv])       # [k_max+1]
 
-    Per-slot work is expressed as scans of 1-D-indexed gathers/scatters:
-    2-D gathers whose index arrays are themselves computed lower ~30x slower
-    on TPU than their 1-D or constant-index forms (see cluster/leiden.py's
-    identical restructuring).
-    """
-    idx = jnp.asarray(idx, jnp.int32)
+    def body(r, q):
+        other_q = lists[:, q][idx]                            # [n, k_max]
+        mask = (lists[:, :, None] == other_q[:, None, :]) & pvalid[None, :, None]
+        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], jnp.inf), axis=1)
+        r_new = jnp.minimum(r, best_p + q.astype(jnp.float32))
+        return jnp.where(pvalid[q], r_new, r), None
+
+    # `+ idx[0,0]*0` inherits idx's varying-manual-axes type (scan-vma rule)
+    r0 = jnp.full((n, k_max), jnp.inf) + (idx[0, 0] * 0).astype(jnp.float32)
+    r, _ = jax.lax.scan(body, r0, jnp.arange(k_max + 1))
+    w = jnp.maximum(kv.astype(jnp.float32) - r / 2.0, 0.0)
+    return jnp.where(colv[None, :], w, 0.0)
+
+
+def _assemble_graph(idx: jax.Array, w_out: jax.Array, colv) -> SNNGraph:
+    """Symmetrise [n, k] out-edges into the [n, 2k] slot graph. ``colv`` is
+    None for the plain build, or a [k] bool mask of valid columns for the
+    mask-based build (invalid slots: nbr = self id, w = 0)."""
     n, k = idx.shape
-    w_out = _rank_weights(idx)                                # [n, k]
     node_ids = jnp.arange(n, dtype=idx.dtype)
 
     # mutual[i, a] = i in kNN(idx[i, a]); per-slot scan keeps the row gather
     # 1-D-indexed ([n] computed ids picking [n, k] rows)
     def mutual_slot(_, col):
-        return _, jnp.any(idx[col] == node_ids[:, None], axis=1)
+        hit = idx[col] == node_ids[:, None]
+        if colv is not None:  # only the target's first kv columns count
+            hit = hit & colv[None, :]
+        return _, jnp.any(hit, axis=1)
 
     _, mutual_t = jax.lax.scan(mutual_slot, None, jnp.moveaxis(idx, 1, 0))
     mutual = jnp.moveaxis(mutual_t, 0, 1)                     # [n, k]
@@ -94,7 +129,8 @@ def snn_graph(idx: jax.Array) -> SNNGraph:
     # Slot (j, a) receives the source whose a-th neighbour is j; collisions
     # (several sources sharing the a-th-neighbour j) keep one arbitrarily —
     # the dropped duplicates are rare and only shave edge weight, never add.
-    src = jnp.where(~mutual, node_ids[:, None], -1)
+    live = ~mutual if colv is None else (~mutual & colv[None, :])
+    src = jnp.where(live, node_ids[:, None], -1)
 
     def rev_slot(_, slot):
         col, src_col, w_col = slot
@@ -110,7 +146,32 @@ def snn_graph(idx: jax.Array) -> SNNGraph:
     rev_nbr = jnp.moveaxis(rev_nbr_t, 0, 1)                   # [n, k]
     rev_w = jnp.moveaxis(rev_w_t, 0, 1)
 
-    nbr = jnp.concatenate([idx, rev_nbr], axis=1)
+    nbr_out = idx if colv is None else jnp.where(colv[None, :], idx, node_ids[:, None])
+    nbr = jnp.concatenate([nbr_out, rev_nbr], axis=1)
     w = jnp.concatenate([w_out, rev_w], axis=1)
     deg = jnp.sum(w, axis=1)
     return SNNGraph(nbr=nbr, w=w, deg=deg, two_m=jnp.sum(deg))
+
+
+@jax.jit
+def snn_graph(idx: jax.Array, k: Optional[jax.Array] = None) -> SNNGraph:
+    """Build the symmetric rank-weighted SNN graph from kNN indices [n, k].
+
+    With ``k=None`` (the default) every column is an edge — the historical
+    contract. With ``k=kv`` (a traced value is fine), ``idx`` is a padded
+    [n, k_max] tensor and only the first ``kv`` columns become edges: the
+    output keeps the full [n, 2*k_max] slot layout with invalid slots inert
+    (nbr = self, w = 0), so one program covers every k of a k sweep — the
+    fused ``cluster_grid`` vmaps this over its k axis.
+
+    Per-slot work is expressed as scans of 1-D-indexed gathers/scatters:
+    2-D gathers whose index arrays are themselves computed lower ~30x slower
+    on TPU than their 1-D or constant-index forms (see cluster/leiden.py's
+    identical restructuring).
+    """
+    idx = jnp.asarray(idx, jnp.int32)
+    if k is None:
+        return _assemble_graph(idx, _rank_weights(idx), None)
+    kv = jnp.asarray(k, jnp.int32)
+    colv = jnp.arange(idx.shape[1], dtype=jnp.int32) < kv
+    return _assemble_graph(idx, _rank_weights_masked(idx, kv), colv)

@@ -20,7 +20,6 @@ fallback (SURVEY §5 failure-detection row).
 
 from __future__ import annotations
 
-import functools
 import os
 from typing import NamedTuple, Optional
 
@@ -43,7 +42,11 @@ from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
 from consensusclustr_tpu.cluster.engine import consensus_candidate_score
 from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
-from consensusclustr_tpu.consensus.cocluster import coclustering_distance
+from consensusclustr_tpu.consensus.cocluster import (
+    CoclusterAccumulator,
+    _pallas_wanted,
+    coclustering_distance,
+)
 from consensusclustr_tpu.consensus.merge import (
     merge_small_clusters,
     merge_unstable_clusters,
@@ -55,6 +58,7 @@ from consensusclustr_tpu.parallel.pipelined import (
     pipeline_depth,
 )
 from consensusclustr_tpu.utils.backend import default_backend as _default_backend
+from consensusclustr_tpu.utils.compile_cache import counting_jit
 from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
 
@@ -71,8 +75,7 @@ class ConsensusResult(NamedTuple):
     n_clusters: int
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
         "cluster_fun", "compute_dtype",
@@ -114,26 +117,30 @@ def _boot_batch(
 
 
 def _auto_boot_chunk(
-    n: int, m: int, nboots: int, requested: int, n_res: int, k_max: int
+    n: int, m: int, nboots: int, requested: int, n_res: int, k_max: int,
+    n_k: int = 1,
 ) -> int:
     if requested > 0:
         return max(1, min(requested, nboots))
     # Bound the per-chunk workspace: the blockwise kNN row tile plus the
-    # Leiden local-move working set per resolution — the [m, slab, e]
-    # equality-slab transient plus ~8 [m, e] gather/gain buffers (e = 2k edge
-    # slots), vmapped over n_res. The TPU runtime hard-crashes (not OOMs
-    # gracefully) when pushed, so track a conservative budget against the
-    # 16 GB HBM.
+    # Leiden local-move working set per grid candidate — the [m, slab, e]
+    # equality-slab transient plus ~8 [m, e] gather/gain buffers (e = 2k_max
+    # edge slots), vmapped over the FUSED [n_k, n_res] candidate grid (the
+    # batched-k cluster_grid runs every k concurrently, so the k axis
+    # multiplies the live working set where the old per-k loop paid it
+    # sequentially). The TPU runtime hard-crashes (not OOMs gracefully) when
+    # pushed, so track a conservative budget against the 16 GB HBM.
     from consensusclustr_tpu.cluster.knn import KNN_BLOCK
     from consensusclustr_tpu.cluster.leiden import _SLAB, _auto_kc
 
     e = 2 * k_max
+    n_cand = n_res * max(1, n_k)
     knn_bytes = (m * m if m <= 2 * KNN_BLOCK else KNN_BLOCK * m) * 4.0
     # coarse community-merge phase: ~6 live [kc, kc] f32 matrices per
-    # resolution instance (big_w, its transpose-fold, gain, outer(k_deg))
+    # grid-candidate instance (big_w, its transpose-fold, gain, outer(k_deg))
     kc = min(_auto_kc(m), m)
-    coarse_bytes = n_res * kc * kc * 4.0 * 6.0
-    per_boot = knn_bytes + coarse_bytes + n_res * m * e * 4.0 * (8.0 + _SLAB)
+    coarse_bytes = n_cand * kc * kc * 4.0 * 6.0
+    per_boot = knn_bytes + coarse_bytes + n_cand * m * e * 4.0 * (8.0 + _SLAB)
     backend = _default_backend()
     on_cpu = backend == "cpu"
     budget = float(os.environ.get("CCTPU_CHUNK_BYTES", 2e9 if on_cpu else 6e9))
@@ -147,7 +154,10 @@ def _auto_boot_chunk(
     return int(max(1, min(nboots, budget // max(per_boot, 1.0), cap)))
 
 
-def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None):
+def run_bootstraps(
+    key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None,
+    accumulator: Optional[CoclusterAccumulator] = None,
+):
     """All bootstrap clusterings, chunked over the boot axis.
 
     Returns (boot_labels [B_eff, n] int32 with -1 for unsampled, scores).
@@ -159,6 +169,13 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     (SURVEY §5 checkpoint row). Granular mode checkpoints the flattened
     candidate axis — |k_num| * |res_range| rows per boot — so the grid shape
     is part of the fingerprint.
+
+    ``accumulator`` (a CoclusterAccumulator) streams each chunk's aligned
+    labels into the donated co-clustering counts the moment the chunk is
+    enqueued: computed chunks feed their DEVICE label batch (the accumulator
+    update rides the async stream behind the chunk itself — no host round
+    trip), resumed chunks feed their host rows. Totals are integer counts, so
+    the result is bit-identical to a one-shot pass over all rows.
     """
     n, _ = pca.shape
     m = max(2, int(round(cfg.boot_size * n)))
@@ -167,7 +184,8 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     k_list = tuple(int(k) for k in cfg.k_num)
     robust = cfg.mode == "robust"
     chunk = _auto_boot_chunk(
-        n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list)
+        n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list),
+        n_k=len(k_list),
     )
 
     ckpt = None
@@ -196,6 +214,10 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
                 "compute_dtype": cfg.compute_dtype,
                 "n_iters": DEFAULT_COMMUNITY_ITERS,
                 "k_coarse": _leiden_auto_kc(m),
+                # the fused [K, R] grid runs Leiden on padded [m, 2*k_max]
+                # slot graphs — per-boot labels differ from the pre-fusion
+                # per-k loop's, so old chunks must not resume into a fused run
+                "grid": "fused-kmask-v1",
             },
             np.asarray(jax.random.key_data(key)).tobytes(),
         )
@@ -215,7 +237,20 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     # behavior reproduced exactly). save_chunk stays atomic (tmp + replace)
     # on the writer thread, so no torn files either way.
     writer = AsyncChunkWriter() if (ckpt is not None and depth > 1) else None
-    pipe = ChunkPipeline(depth, metrics=mets)
+
+    def _feed_accumulator(ent):
+        # Donated-carry co-clustering accumulation at enqueue time (ISSUE 5):
+        # computed chunks hand their device label batch straight to the
+        # accumulator update (async, behind the chunk's own execution);
+        # resumed chunks hand their host rows. Chunk order == boot order, and
+        # the counts are integers, so the totals are order-exact either way.
+        labels_part = ent.peek()[0]
+        accumulator.update(jnp.asarray(labels_part, jnp.int32).reshape(-1, n))
+
+    pipe = ChunkPipeline(
+        depth, metrics=mets,
+        on_enqueue=_feed_accumulator if accumulator is not None else None,
+    )
 
     def _consume(ent):
         s, e = ent.meta
@@ -297,9 +332,7 @@ def run_bootstraps(key, pca, cfg: ClusterConfig, log: Optional[LevelLog] = None)
     return labels, scores
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k_list", "max_clusters", "n_iters", "cluster_fun")
-)
+@counting_jit(static_argnames=("k_list", "max_clusters", "n_iters", "cluster_fun"))
 def _consensus_grid_from_knn(
     key: jax.Array,
     knn_idx: jax.Array,  # [n, max(k_list)] kNN of the consensus distance
@@ -538,16 +571,31 @@ def consensus_cluster(
             n_clusters=len(np.unique(labels)),
         )
 
-    boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log)
     dense = cfg.dense_consensus
     if dense is None:
         dense = n <= DENSE_CONSENSUS_LIMIT
+    # Dense einsum regime: stream the co-clustering counts into a donated
+    # accumulator DURING the boot fan-out (each chunk's device labels feed an
+    # in-place [n, n] count update on the async stream) instead of one
+    # fused pass over all rows afterwards — bit-identical (integer counts),
+    # but the consensus matrix is ready the moment the boots drain and the
+    # accumulator never double-buffers. The Pallas regime keeps the one-shot
+    # tiled kernel (it wants the full int8 label matrix at once).
+    accum = None
+    if dense and cfg.nboots > 1 and not _pallas_wanted(cfg.use_pallas, cfg.max_clusters):
+        accum = CoclusterAccumulator(n, cfg.max_clusters)
+    boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log, accumulator=accum)
     if dense:
-        with maybe_span(log, "cocluster", dense=True) as sp:
-            dist = coclustering_distance(
-                jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
-                use_pallas=cfg.use_pallas,
-            )
+        with maybe_span(
+            log, "cocluster", dense=True, streamed=accum is not None
+        ) as sp:
+            if accum is not None:
+                dist = accum.distance()
+            else:
+                dist = coclustering_distance(
+                    jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
+                    use_pallas=cfg.use_pallas,
+                )
             sp.value = dist
         with maybe_span(log, "consensus_grid") as sp:
             cons_labels, cons_scores = _consensus_grid(

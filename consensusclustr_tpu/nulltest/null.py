@@ -16,7 +16,6 @@ replicates (SURVEY §2.4 null-simulation row).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -40,11 +39,11 @@ from consensusclustr_tpu.prep.sizefactors import (
 from consensusclustr_tpu.obs import maybe_span, metrics_of
 from consensusclustr_tpu.parallel.pipelined import ChunkPipeline, pipeline_depth
 from consensusclustr_tpu.prep.transform import shifted_log
+from consensusclustr_tpu.utils.compile_cache import counting_jit
 from consensusclustr_tpu.utils.rng import sim_key
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "n_cells", "pc_num", "k_list", "pool_sizes", "max_clusters", "has_cov",
         "cluster_fun", "compute_dtype",

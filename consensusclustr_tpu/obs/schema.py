@@ -20,7 +20,11 @@ from __future__ import annotations
 # fields (log-spaced le buckets, obs/hist.py); ``phase_seconds`` histogram and
 # the ``serve_metrics`` event were added; METRIC_HELP (below) became part of
 # the registry contract.
-SCHEMA_VERSION = 2
+# v3 (ISSUE 5): dispatch/compile accounting — ``device_dispatches``,
+# ``executable_compiles`` and ``donated_bytes`` counters (sourced by
+# utils/compile_cache.counting_jit, emitted per bench rung, rendered by
+# tools/report.py's "== dispatch ==" table). See docs/quirks.md.
+SCHEMA_VERSION = 3
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -112,6 +116,10 @@ METRIC_HELP = {
     "serve_compile": "counter: bucket-shape first dispatches (XLA compiles)",
     "serve_rejections": "counter: queue-full backpressure rejections",
     "compile_cache_enable_calls": "counter: enable_persistent_cache invocations (idempotency telemetry)",
+    # dispatch/compile accounting (utils/compile_cache.counting_jit, ISSUE 5)
+    "device_dispatches": "counter: top-level pipeline executable launches (counting_jit-wrapped entry programs)",
+    "executable_compiles": "counter: traces of top-level entry programs (one per shape bucket)",
+    "donated_bytes": "counter: bytes of operand buffers donated for in-place executable updates",
 }
 
 # Metrics registry names (counters, gauges, histograms).

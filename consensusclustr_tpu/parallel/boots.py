@@ -20,7 +20,6 @@ so assignments are bit-identical regardless of mesh shape.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -34,10 +33,10 @@ from consensusclustr_tpu.cluster.engine import (
     ties_last_argmax,
 )
 from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
+from consensusclustr_tpu.utils.compile_cache import counting_jit
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun",
         "compute_dtype",
@@ -90,8 +89,7 @@ def sharded_run_bootstraps_granular(
     )(keys, idx, jnp.asarray(pca, jnp.float32), jnp.asarray(res_list, jnp.float32))
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_cells", "cluster_fun",
         "compute_dtype"

@@ -28,6 +28,32 @@ BOOT_AXIS = "boot"
 CELL_AXIS = "cell"
 
 
+def shard_map_capability() -> Tuple[bool, str]:
+    """Can this environment run the sharded (shard_map) paths at all?
+
+    The distributed step is written against the ``jax.shard_map`` /
+    varying-manual-axes API (``jax.lax.pcast``) and needs more than one
+    local device for sharding to mean anything. Returns ``(ok, reason)``
+    with ``reason`` naming the first missing capability. The tier-1 suite
+    uses this to *skip* the sharded tests with an explicit environment
+    reason — a red sharded test should mean broken code, not a CPU sandbox
+    whose jax predates the API.
+    """
+    if not hasattr(jax, "shard_map"):
+        return False, f"jax.shard_map not in jax {jax.__version__}"
+    if not hasattr(jax.lax, "pcast"):
+        return False, (
+            f"jax.lax.pcast (varying-manual-axes API) not in jax {jax.__version__}"
+        )
+    try:
+        n = len(jax.devices())
+    except Exception as e:  # backend init failed: nothing to shard over
+        return False, f"device enumeration failed: {type(e).__name__}: {e}"
+    if n < 2:
+        return False, f"needs >= 2 local devices, found {n}"
+    return True, ""
+
+
 def factor_devices(n_devices: int) -> Tuple[int, int]:
     """Split a device count into (boot, cell) mesh extents.
 

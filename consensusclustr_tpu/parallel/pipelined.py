@@ -153,6 +153,14 @@ class PendingChunk:
         self.overlap_seconds = 0.0
         self.latency_seconds = 0.0
 
+    def peek(self) -> Any:
+        """The raw payload — device arrays for a dispatched chunk, the host
+        value for a ready one — WITHOUT forcing a fetch. For consumers that
+        chain further device work onto an in-flight chunk (the donated
+        co-clustering accumulator feeds on this), keeping the whole
+        accumulation on the async stream."""
+        return self._value if self._fetched else self._payload
+
     def fetch(self) -> Any:
         """Host value of this chunk; blocks on the device the first time."""
         if not self._fetched:
@@ -190,11 +198,23 @@ class ChunkPipeline:
     (``put_ready``) occupy the ordered window but not a device slot.
     """
 
-    def __init__(self, depth: int, metrics: Optional[MetricsRegistry] = None):
+    def __init__(
+        self,
+        depth: int,
+        metrics: Optional[MetricsRegistry] = None,
+        on_enqueue: Optional[Callable[["PendingChunk"], None]] = None,
+    ):
+        """``on_enqueue``, when given, runs synchronously for every entry the
+        moment it joins the window (``put`` AND ``put_ready``) — the hook the
+        chunk drivers use to chain follow-on device work (e.g. the donated
+        co-clustering accumulator) onto a chunk right at dispatch, while the
+        chunk itself is still executing. The hook sees the entry before any
+        fetch: use ``ent.peek()`` for the raw payload."""
         self.depth = int(depth)
         if self.depth < 1:
             raise ValueError(f"pipeline depth must be >= 1; got {self.depth}")
         self._metrics = metrics
+        self._on_enqueue = on_enqueue
         self._window: "deque[PendingChunk]" = deque()
         self._inflight = 0
         self.max_inflight = 0
@@ -227,12 +247,16 @@ class ChunkPipeline:
                 # high-water mark: a last-write gauge would always read 0
                 # after the drain, which is the only time records snapshot it
                 self._metrics.gauge("inflight_chunks").set(self.max_inflight)
+        if self._on_enqueue is not None:
+            self._on_enqueue(ent)
         return ent
 
     def put_ready(self, index: int, value: Any, meta: Any = None) -> PendingChunk:
         """Enqueue a host-ready value (resume cache) in chunk order."""
         ent = PendingChunk(self, index, value, meta, ready=True)
         self._window.append(ent)
+        if self._on_enqueue is not None:
+            self._on_enqueue(ent)
         return ent
 
     # -- consumer side -------------------------------------------------------

@@ -50,6 +50,7 @@ from consensusclustr_tpu.parallel.cocluster import (
 from consensusclustr_tpu.obs import metrics_of
 from consensusclustr_tpu.parallel.knn import sharded_knn_from_distance
 from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS
+from consensusclustr_tpu.utils.compile_cache import counting_jit
 from consensusclustr_tpu.utils.rng import cluster_key
 
 
@@ -105,8 +106,7 @@ class DistributedStepResult(NamedTuple):
     boot_labels: jax.Array  # [B_pad, n] aligned boot assignments (boot-sharded)
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "cluster_fun", "dense",
     ),
@@ -161,8 +161,7 @@ def _consensus_tail_sharded(
     return labels[best], scores, dist
 
 
-@functools.partial(
-    jax.jit,
+@counting_jit(
     static_argnames=(
         "mesh", "k_list", "max_clusters", "n_iters", "n_res_real", "cluster_fun",
         "compute_dtype", "dense", "granular",

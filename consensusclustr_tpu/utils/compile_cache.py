@@ -19,6 +19,7 @@ process. The function returns that resolved state so callers can log it.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -27,6 +28,67 @@ from consensusclustr_tpu.obs import global_metrics
 from consensusclustr_tpu.utils.backend import default_backend
 
 _done = False
+
+
+def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
+    """``jax.jit`` with dispatch/compile/donation accounting (ISSUE 5).
+
+    Wraps the pipeline's TOP-LEVEL jitted entry programs and counts, in the
+    process-global metrics registry (obs/schema.py):
+
+      * ``device_dispatches`` — calls that launch an executable. A call made
+        while an enclosing program is being traced inlines into that program
+        and is NOT counted (that is the point of fusing: fewer dispatches).
+      * ``executable_compiles`` — traces, i.e. new (shape, static-args) cache
+        entries. One per shape bucket; counted even when the persistent XLA
+        cache serves the binary (a trace is the compile-shaped host work the
+        accounting is meant to expose).
+      * ``donated_bytes`` — bytes of operand buffers handed to the executable
+        via ``donate_argnums`` per dispatch (in-place carry updates: the
+        consensus accumulator, per-chunk key/index slices).
+
+    The counters cover exactly the functions wrapped here — the per-boot hot
+    path and its chunk drivers — not every small jit in the package, so
+    bench deltas are stable, gateable program counts (tools/bench_diff.py
+    ``--gate compiles:...``).
+    """
+    if fun is None:
+        return functools.partial(
+            counting_jit, donate_argnums=donate_argnums, **jit_kwargs
+        )
+    donate = tuple(donate_argnums)
+
+    @functools.wraps(fun)
+    def _traced(*args, **kwargs):
+        # runs once per jit cache entry (trace time), not per call
+        global_metrics().counter("executable_compiles").inc()
+        return fun(*args, **kwargs)
+
+    jitted = jax.jit(_traced, donate_argnums=donate, **jit_kwargs)
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+            return fun(*args, **kwargs)  # inlining into an enclosing program
+        mets = global_metrics()
+        mets.counter("device_dispatches").inc()
+        if donate:
+            nbytes = 0
+            for i in donate:
+                if i < len(args):
+                    for leaf in jax.tree_util.tree_leaves(args[i]):
+                        nbytes += int(getattr(leaf, "nbytes", 0) or 0)
+            mets.counter("donated_bytes").inc(nbytes)
+        return jitted(*args, **kwargs)
+
+    wrapper._counting_jitted = jitted  # escape hatch (lower/AOT, tests)
+    # preserve the jax.jit introspection surface callers already rely on
+    # (e.g. tests/test_buckets.py bounds _boot_batch._cache_size())
+    for attr in ("_cache_size", "clear_cache", "lower", "trace", "eval_shape"):
+        if hasattr(jitted, attr):
+            setattr(wrapper, attr, getattr(jitted, attr))
+    return wrapper
 
 
 def enable_persistent_cache() -> bool:

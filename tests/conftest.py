@@ -22,6 +22,19 @@ import pytest  # noqa: E402
 
 assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
+# Capability gate for the sharded (shard_map) paths: when the environment's
+# jax predates the jax.shard_map / varying-manual-axes API (or has a single
+# device), those tests SKIP with the environment reason instead of failing —
+# tier-1 red should mean broken code, not a sandbox whose jax is too old
+# (ISSUE 5 satellite; the 18 pre-existing failures were all this).
+from consensusclustr_tpu.parallel.mesh import shard_map_capability  # noqa: E402
+
+_SHARD_OK, _SHARD_REASON = shard_map_capability()
+requires_shard_map = pytest.mark.skipif(
+    not _SHARD_OK,
+    reason=f"sharded (shard_map) paths unavailable in this env: {_SHARD_REASON}",
+)
+
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running statistical test")

@@ -278,3 +278,67 @@ class TestBackendResolver:
             assert bk.default_backend() == "sentinel"
         finally:
             jax.config.update("jax_platforms", "cpu")
+
+
+class TestBenchProbeBudget:
+    """bench.py probe hardening (ISSUE 5 satellite): configurable budget,
+    process-cached verdict, probe_s reported separately from wall_s."""
+
+    def _bench(self):
+        import importlib
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        import bench
+
+        return importlib.reload(bench)
+
+    def test_budget_env_resolution(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.delenv("CCTPU_BENCH_PROBE_BUDGET", raising=False)
+        monkeypatch.delenv("BENCH_PROBE_BUDGET_SECS", raising=False)
+        assert bench._probe_budget_secs() == 240  # well under the old 1020 s
+        monkeypatch.setenv("BENCH_PROBE_BUDGET_SECS", "900")
+        assert bench._probe_budget_secs() == 900  # legacy knob still honored
+        monkeypatch.setenv("CCTPU_BENCH_PROBE_BUDGET", "60")
+        assert bench._probe_budget_secs() == 60  # new knob wins
+        monkeypatch.setenv("CCTPU_BENCH_PROBE_BUDGET", "junk")
+        assert bench._probe_budget_secs() == 900  # junk ignored, falls back
+
+    def test_probe_verdict_cached_for_process(self, monkeypatch):
+        bench = self._bench()
+        calls = []
+        monkeypatch.setattr(
+            bench, "_backend_probe_ok", lambda *a, **k: calls.append(1) or True
+        )
+        assert bench._await_healthy_backend() == "healthy"
+        assert bench._await_healthy_backend() == "healthy"
+        assert len(calls) == 1  # second call answered from _PROBE_CACHE
+        assert bench._PROBE_CACHE["seconds"] >= 0.0
+
+    def test_inherited_verdict_skips_probe(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("CCTPU_BENCH_PROBE_VERDICT", "cpu_forced_after_60s")
+        monkeypatch.setenv("CCTPU_BENCH_PROBE_S", "60.5")
+        monkeypatch.setattr(
+            bench, "_backend_probe_ok",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")),
+        )
+        assert bench._await_healthy_backend() == "cpu_forced_after_60s"
+        assert bench._PROBE_CACHE["seconds"] == 60.5
+
+    def test_dispatch_delta_shape(self):
+        bench = self._bench()
+        before = {"device_dispatches": 3, "executable_compiles": 1,
+                  "donated_bytes": 100}
+        after = {"device_dispatches": 7, "executable_compiles": 1,
+                 "donated_bytes": 400}
+        delta = bench._dispatch_delta(before, after)
+        assert delta == {"device_dispatches": 4, "executable_compiles": 0,
+                         "donated_bytes": 300}
+        # live counters carry every key the payload contract names
+        live = bench._dispatch_counters()
+        assert set(live) == set(bench._DISPATCH_KEYS)

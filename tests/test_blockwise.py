@@ -20,6 +20,8 @@ from consensusclustr_tpu.consensus.cocluster import _einsum_coclustering_distanc
 from consensusclustr_tpu.consensus.merge import merge_small_clusters
 from consensusclustr_tpu.cluster.knn import knn_from_distance
 
+from conftest import requires_shard_map
+
 
 def _boot_labels(n=700, b=12, c=5, noise=0.2, seed=0):
     """Synthetic boot assignments with planted co-clustering structure."""
@@ -123,6 +125,7 @@ def test_consensus_clust_blockwise_equals_dense():
         )
 
 
+@requires_shard_map
 def test_sharded_blockwise_knn_matches_single_chip():
     from consensusclustr_tpu.parallel.cocluster import (
         sharded_blockwise_consensus_knn,
@@ -140,6 +143,7 @@ def test_sharded_blockwise_knn_matches_single_chip():
     assert same > 0.9, same
 
 
+@requires_shard_map
 def test_distributed_step_dense_false_matches_dense_labels():
     from consensusclustr_tpu.config import ClusterConfig
     from consensusclustr_tpu.parallel.mesh import consensus_mesh
@@ -159,6 +163,7 @@ def test_distributed_step_dense_false_matches_dense_labels():
 
 
 @pytest.mark.slow
+@requires_shard_map
 def test_granular_blockwise_sharded_matches_dense():
     """BASELINE config 2 regime (VERDICT r3 next #7): granular mode — every
     (k, res) candidate of every boot in the consensus — through the blockwise
@@ -212,6 +217,7 @@ def test_scale_200k_blockwise_bounded_memory():
     assert agree > 0.95, agree
 
 
+@requires_shard_map
 def test_sharded_blockwise_knn_pads_indivisible_n():
     """n not divisible by the device count pads with -1 cells that never
     contaminate real rows (they lose all top_k ties)."""
@@ -247,6 +253,7 @@ def test_euclidean_cluster_distance_matches_dense():
     np.testing.assert_allclose(got[off], want[off], rtol=1e-4, atol=1e-4)
 
 
+@requires_shard_map
 def test_sharded_blockwise_knn_pallas_tile_matches_einsum(monkeypatch):
     """Opt-in sharded Pallas tile (CCTPU_SHARDED_PALLAS=1, interpret mode on
     the CPU mesh): identical kNN graph to the sharded einsum tile. The env is

@@ -522,6 +522,30 @@ class TestBenchDiff:
         assert proc.returncode == 1
         assert "missing" in proc.stderr
 
+    def test_compiles_gate_lower_is_better(self, tmp_path):
+        """ISSUE 5 satellite: --gate compiles:... (alias for the
+        executable_compiles rung, lower is better) fails a payload pair whose
+        NEW side compiles more top-level executables."""
+        old = _payload(schema=3, executable_compiles=10, device_dispatches=40,
+                       probe_s=2.0)
+        worse = _payload(schema=3, executable_compiles=14, device_dispatches=40,
+                         probe_s=2.0)
+        bad = self._run(tmp_path, old, worse, "--gate", "compiles:0.9")
+        assert bad.returncode == 3
+        assert "executable_compiles" in bad.stderr
+        same = _payload(schema=3, executable_compiles=10, device_dispatches=40,
+                        probe_s=2.0)
+        ok = self._run(tmp_path, old, same, "--gate", "compiles:0.9",
+                       "--gate", "dispatches:0.9")
+        assert ok.returncode == 0, ok.stderr
+        # the dispatch rungs render in the delta table with the v direction
+        assert "executable_compiles" in ok.stdout and "probe_s" in ok.stdout
+
+    def test_gate_unknown_rung_still_loud(self, tmp_path):
+        proc = self._run(tmp_path, _payload(), _payload(), "--gate", "nonsense:0.5")
+        assert proc.returncode == 1
+        assert "aliases" in proc.stderr
+
     def test_wrapper_and_tail_fallback(self, tmp_path):
         wrapped_old = {"n": 1, "rc": 0, "parsed": _payload(1.0)}
         wrapped_new = {

@@ -1,0 +1,352 @@
+"""Fused single-program bootstrap grid (ISSUE 5): bit-parity of the batched-k
+``cluster_grid`` against the per-k loop oracle, the masked SNN build against
+the sliced build, the donated co-clustering accumulator against the one-shot
+pass, and the dispatch/compile accounting sourced by
+``utils/compile_cache.counting_jit``.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consensusclustr_tpu.cluster.engine import (
+    cluster_grid,
+    cluster_grid_looped,
+)
+from consensusclustr_tpu.cluster.knn import knn_points
+from consensusclustr_tpu.cluster.snn import snn_graph
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.cocluster import (
+    CoclusterAccumulator,
+    coclustering_distance,
+)
+from consensusclustr_tpu.consensus.pipeline import consensus_cluster, run_bootstraps
+from consensusclustr_tpu.obs import global_metrics
+from consensusclustr_tpu.utils.compile_cache import counting_jit
+from consensusclustr_tpu.utils.rng import root_key
+
+from conftest import make_blobs, requires_shard_map
+
+
+def _blob_pca(n=150, d=6, pops=4, seed=0):
+    r = np.random.default_rng(seed)
+    centers = r.normal(0.0, 6.0, size=(pops, d))
+    return (
+        centers[r.integers(0, pops, size=n)] + r.normal(0, 1.0, size=(n, d))
+    ).astype(np.float32)
+
+
+def _dispatch_counts():
+    c = global_metrics().counters
+    return {
+        k: (c[k].value if k in c else 0.0)
+        for k in ("device_dispatches", "executable_compiles", "donated_bytes")
+    }
+
+
+def _grid_as_np(g):
+    return tuple(np.asarray(a) for a in (g.labels, g.n_clusters, g.scores))
+
+
+# ---------- masked SNN build ----------
+
+
+class TestMaskedSNN:
+    def test_masked_matches_sliced_exactly(self):
+        """snn_graph(idx, k=kv) valid slots must be BIT-identical to
+        snn_graph(idx[:, :kv]) — including deg/two_m (rank weights are dyadic
+        rationals, their sums are exact in f32) — and invalid slots inert."""
+        r = np.random.default_rng(8)
+        x = r.normal(size=(200, 6)).astype(np.float32)
+        kmax = 20
+        idx, _ = knn_points(jnp.asarray(x), kmax)
+        n = x.shape[0]
+        for k in (5, 10, 15, 20):
+            ref = snn_graph(idx[:, :k])
+            got = snn_graph(idx, k=jnp.int32(k))
+            sel = np.r_[0:k, kmax:kmax + k]
+            np.testing.assert_array_equal(np.asarray(ref.nbr), np.asarray(got.nbr)[:, sel])
+            np.testing.assert_array_equal(np.asarray(ref.w), np.asarray(got.w)[:, sel])
+            np.testing.assert_array_equal(np.asarray(ref.deg), np.asarray(got.deg))
+            np.testing.assert_array_equal(np.asarray(ref.two_m), np.asarray(got.two_m))
+            inv = np.r_[k:kmax, kmax + k:2 * kmax]
+            assert (np.asarray(got.w)[:, inv] == 0.0).all()
+            assert (np.asarray(got.nbr)[:, inv] == np.arange(n)[:, None]).all()
+
+    def test_masked_degenerate_n_below_k(self):
+        # n - 1 < k: knn pads by repeating the last true column; the masked
+        # build must agree with the sliced build on the padded tensor too
+        r = np.random.default_rng(3)
+        x = r.normal(size=(6, 2)).astype(np.float32)
+        idx, _ = knn_points(jnp.asarray(x), 10)
+        ref = snn_graph(idx[:, :8])
+        got = snn_graph(idx, k=jnp.int32(8))
+        sel = np.r_[0:8, 10:18]
+        np.testing.assert_array_equal(np.asarray(ref.w), np.asarray(got.w)[:, sel])
+        np.testing.assert_array_equal(np.asarray(ref.deg), np.asarray(got.deg))
+
+    def test_default_call_unchanged(self):
+        # the historical one-arg contract: every column is an edge
+        r = np.random.default_rng(5)
+        x = r.normal(size=(50, 3)).astype(np.float32)
+        idx, _ = knn_points(jnp.asarray(x), 6)
+        a, b = snn_graph(idx), snn_graph(idx, k=jnp.int32(6))
+        np.testing.assert_array_equal(np.asarray(a.w), np.asarray(b.w))
+        np.testing.assert_array_equal(np.asarray(a.nbr), np.asarray(b.nbr))
+
+
+# ---------- fused grid bit-parity ----------
+
+
+class TestFusedGridParity:
+    RES = (0.1, 0.5, 1.0, 1.6)
+
+    def _run_both(self, x, k_list, cluster_fun="leiden", min_size=0.0, seed=3):
+        key = jax.random.key(seed)
+        res = jnp.asarray(self.RES, jnp.float32)
+        args = (key, jnp.asarray(x), res, k_list, jnp.float32(min_size))
+        kw = dict(max_clusters=32, cluster_fun=cluster_fun)
+        return cluster_grid(*args, **kw), cluster_grid_looped(*args, **kw)
+
+    @pytest.mark.parametrize("cluster_fun", ["leiden", "louvain"])
+    def test_fused_matches_looped(self, cluster_fun):
+        x = _blob_pca(n=160, seed=1)
+        fused, looped = self._run_both(x, (6, 10, 15), cluster_fun=cluster_fun)
+        for a, b in zip(_grid_as_np(fused), _grid_as_np(looped)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_fused_matches_looped_degenerate_n_below_k(self):
+        x = np.random.default_rng(2).normal(size=(8, 3)).astype(np.float32)
+        fused, looped = self._run_both(x, (6, 10), seed=5)
+        for a, b in zip(_grid_as_np(fused), _grid_as_np(looped)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_fused_matches_looped_under_boot_vmap(self):
+        """The robust/granular boot fan-out wraps cluster_grid in a vmap over
+        bootstrap gathers (_boot_batch); parity must survive that outer
+        batching for both the full grid (granular rows) and the argmax
+        selection (robust)."""
+        x = _blob_pca(n=120, seed=7)
+        key = root_key(11)
+        r = np.random.default_rng(0)
+        idx = jnp.asarray(r.integers(0, 120, size=(3, 100)), jnp.int32)
+        res = jnp.asarray(self.RES, jnp.float32)
+
+        def one(grid_fn, idx_b):
+            return grid_fn(
+                key, jnp.asarray(x)[idx_b], res, (6, 10), jnp.float32(0.0),
+                max_clusters=32,
+            )
+
+        fused = jax.vmap(lambda i: one(cluster_grid, i))(idx)
+        looped = jax.vmap(lambda i: one(cluster_grid_looped, i))(idx)
+        for a, b in zip(_grid_as_np(fused), _grid_as_np(looped)):
+            np.testing.assert_array_equal(a, b)
+        # robust-mode selection consumes scores: identical scores => identical
+        # argmax candidates by construction
+        np.testing.assert_array_equal(
+            np.argmax(np.asarray(fused.scores), axis=1),
+            np.argmax(np.asarray(looped.scores), axis=1),
+        )
+
+    @requires_shard_map
+    def test_fused_grid_inside_shard_map(self):
+        """The sharded boot fan-out runs cluster_grid inside a shard_map
+        kernel (scan-vma rule: carries inherit the varying-manual-axes type
+        from the sharded operands). The fused grid must produce the same
+        candidates sharded as unsharded."""
+        from jax.sharding import PartitionSpec as P
+
+        from consensusclustr_tpu.parallel.mesh import BOOT_AXIS, CELL_AXIS, consensus_mesh
+
+        x = _blob_pca(n=96, seed=9)
+        key = root_key(2)
+        r = np.random.default_rng(1)
+        idx = jnp.asarray(r.integers(0, 96, size=(8, 80)), jnp.int32)
+        res = jnp.asarray(self.RES, jnp.float32)
+
+        def one(idx_b):
+            g = cluster_grid(
+                key, jnp.asarray(x)[idx_b], res, (6, 10), jnp.float32(0.0),
+                max_clusters=32,
+            )
+            return g.labels, g.scores
+
+        mesh = consensus_mesh(boot=4, cell=2)
+        both = (BOOT_AXIS, CELL_AXIS)
+        sharded = jax.shard_map(
+            lambda i: jax.vmap(one)(i),
+            mesh=mesh, in_specs=(P(both, None),),
+            out_specs=(P(both, None, None), P(both, None)),
+        )(idx)
+        local = jax.vmap(one)(idx)
+        np.testing.assert_array_equal(np.asarray(sharded[0]), np.asarray(local[0]))
+        np.testing.assert_array_equal(np.asarray(sharded[1]), np.asarray(local[1]))
+
+
+# ---------- donated co-clustering accumulator ----------
+
+
+class TestCoclusterAccumulator:
+    def _cfg(self, **kw):
+        base = dict(
+            nboots=6, boot_batch=3, res_range=(0.2, 0.8), k_num=(6, 10),
+            max_clusters=32,
+        )
+        base.update(kw)
+        return ClusterConfig(**base)
+
+    def test_accumulator_matches_one_shot_robust(self):
+        pca = _blob_pca(n=140, seed=4)
+        acc = CoclusterAccumulator(140, 32)
+        labels, _ = run_bootstraps(
+            root_key(7), jnp.asarray(pca), self._cfg(), accumulator=acc
+        )
+        assert acc.chunks == 2 and acc.rows == 6
+        ref = coclustering_distance(jnp.asarray(labels, jnp.int32), 32, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(acc.distance()), np.asarray(ref))
+
+    def test_accumulator_matches_one_shot_granular(self):
+        pca = _blob_pca(n=90, seed=6)
+        cfg = self._cfg(mode="granular", nboots=4, boot_batch=2)
+        acc = CoclusterAccumulator(90, 32)
+        labels, _ = run_bootstraps(
+            root_key(9), jnp.asarray(pca), cfg, accumulator=acc
+        )
+        # granular rows: nboots * |k| * |res| flattened candidate rows
+        assert labels.shape == (4 * 2 * 2, 90) and acc.rows == labels.shape[0]
+        ref = coclustering_distance(jnp.asarray(labels, jnp.int32), 32, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(acc.distance()), np.asarray(ref))
+
+    def test_accumulator_matches_after_checkpoint_resume(self, tmp_path):
+        pca = _blob_pca(n=100, seed=12)
+        cfg = self._cfg(checkpoint_dir=str(tmp_path), nboots=4, boot_batch=2)
+        key = root_key(13)
+        labels_first, _ = run_bootstraps(key, jnp.asarray(pca), cfg)
+        # resumed run: every chunk loads from disk and feeds the accumulator
+        acc = CoclusterAccumulator(100, 32)
+        labels, _ = run_bootstraps(key, jnp.asarray(pca), cfg, accumulator=acc)
+        np.testing.assert_array_equal(labels, labels_first)
+        ref = coclustering_distance(jnp.asarray(labels, jnp.int32), 32, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(acc.distance()), np.asarray(ref))
+
+    def test_consensus_cluster_dense_path_streams_exactly(self):
+        """consensus_cluster's dense einsum regime now streams counts through
+        the donated accumulator — its jaccard_dist must equal the one-shot
+        pass over the returned boot labels bit for bit."""
+        pca = _blob_pca(n=130, seed=15)
+        res = consensus_cluster(root_key(21), jnp.asarray(pca), self._cfg())
+        assert res.jaccard_dist is not None
+        ref = coclustering_distance(
+            jnp.asarray(res.boot_labels, jnp.int32), 32, use_pallas=False
+        )
+        np.testing.assert_array_equal(res.jaccard_dist, np.asarray(ref))
+
+    def test_update_donates_and_counts_bytes(self):
+        n = 64
+        acc = CoclusterAccumulator(n, 16)
+        old_agree = acc._agree
+        before = _dispatch_counts()
+        acc.update(np.zeros((4, n), np.int32))
+        after = _dispatch_counts()
+        # two [n, n] f32 carries donated per update
+        assert after["donated_bytes"] - before["donated_bytes"] == 2 * n * n * 4
+        assert after["device_dispatches"] - before["device_dispatches"] == 1
+        jax.block_until_ready(acc._agree)
+        # the previous carry buffer was donated to the update executable
+        with pytest.raises(Exception):
+            np.asarray(old_agree)
+
+    def test_shape_mismatch_is_loud(self):
+        acc = CoclusterAccumulator(32, 8)
+        with pytest.raises(ValueError):
+            acc.update(np.zeros((2, 33), np.int32))
+
+
+# ---------- dispatch/compile accounting ----------
+
+
+class TestDispatchAccounting:
+    def test_counting_jit_dispatch_and_compile_counters(self):
+        calls = []
+
+        @counting_jit(static_argnames=("b",))
+        def f(x, b):
+            calls.append(1)
+            return x * b
+
+        before = _dispatch_counts()
+        f(jnp.ones((3,)), b=2)
+        f(jnp.ones((3,)), b=2)          # cache hit: dispatch, no trace
+        f(jnp.ones((4,)), b=2)          # new shape bucket: trace + dispatch
+        after = _dispatch_counts()
+        assert after["device_dispatches"] - before["device_dispatches"] == 3
+        assert after["executable_compiles"] - before["executable_compiles"] == 2
+        assert len(calls) == 2
+
+    def test_counting_jit_inlines_under_enclosing_trace(self):
+        @counting_jit()
+        def inner(x):
+            return x + 1
+
+        @jax.jit
+        def outer(x):
+            return inner(x) * 2
+
+        before = _dispatch_counts()
+        np.testing.assert_array_equal(np.asarray(outer(jnp.ones((2,)))), [4.0, 4.0])
+        after = _dispatch_counts()
+        # the inner call inlined into outer's trace: no dispatch of its own
+        assert after["device_dispatches"] - before["device_dispatches"] == 0
+
+    def test_one_compile_per_shape_bucket_per_bootstrap_run(self):
+        """The ISSUE 5 acceptance pin: a chunked bootstrap run compiles its
+        boot program ONCE per shape bucket (the fused [K, R] grid is a single
+        executable — not one per k), and dispatches once per chunk."""
+        pca = _blob_pca(n=110, seed=33)  # shapes unique to this test: a jit
+        # cache hit from another test would hide the compile we assert on
+        cfg = ClusterConfig(
+            nboots=4, boot_batch=2, res_range=(0.3, 0.9), k_num=(5, 9, 12),
+            max_clusters=16,
+        )
+        before = _dispatch_counts()
+        run_bootstraps(root_key(17), jnp.asarray(pca), cfg)
+        after = _dispatch_counts()
+        # 4 boots in chunks of 2 -> one shape bucket, two dispatches
+        assert after["executable_compiles"] - before["executable_compiles"] == 1
+        assert after["device_dispatches"] - before["device_dispatches"] == 2
+
+        # a second identical run re-dispatches without re-compiling
+        before = _dispatch_counts()
+        run_bootstraps(root_key(18), jnp.asarray(pca), cfg)
+        after = _dispatch_counts()
+        assert after["executable_compiles"] - before["executable_compiles"] == 0
+        assert after["device_dispatches"] - before["device_dispatches"] == 2
+
+    def test_schema_registers_dispatch_metrics(self):
+        from consensusclustr_tpu.obs import schema
+
+        for name in ("device_dispatches", "executable_compiles", "donated_bytes"):
+            assert name in schema.METRIC_NAMES
+            assert schema.METRIC_HELP[name].strip()
+        assert schema.SCHEMA_VERSION >= 3
+
+
+# ---------- end-to-end sanity of the fused engine ----------
+
+
+def test_fused_grid_quality_on_blobs():
+    """The fused grid must still find planted structure (the behavioral bar
+    the old per-k loop met) — guards against a mask bug that parity alone
+    (fused == looped) could not see."""
+    from sklearn.metrics import adjusted_rand_score
+
+    x, truth = make_blobs(n_per=40, n_genes=6, n_clusters=3, sep=7.0, seed=8)
+    res = cluster_grid(
+        jax.random.key(0), jnp.asarray(x),
+        jnp.asarray([0.1, 0.5, 1.0], jnp.float32), (8, 12), jnp.asarray(5.0),
+        max_clusters=32,
+    )
+    best = int(np.argmax(np.asarray(res.scores)))
+    assert adjusted_rand_score(truth, np.asarray(res.labels[best])) > 0.95

@@ -6,6 +6,9 @@ Usage:
     python tools/bench_diff.py --latest [--dir ROOT]    # newest committed pair
     python tools/bench_diff.py --check  [--dir ROOT]    # structural gate (CI)
     python tools/bench_diff.py OLD NEW --gate value:0.5 --gate serving.qps:0.5
+    python tools/bench_diff.py OLD NEW --gate compiles:0.99   # program-count
+        # gate: "compiles" aliases executable_compiles (lower is better) —
+        # fails when NEW compiles more top-level executables than OLD
 
 Inputs are either the driver wrapper shape committed at the repo root
 ({"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {bench line}}) or a raw
@@ -53,11 +56,24 @@ RUNGS: Dict[str, int] = {
     "boots_per_sec": +1,
     "overlap_ratio": +1,
     "wall_s": -1,
+    "probe_s": -1,
+    # dispatch/compile accounting (obs schema v3): program counts are a perf
+    # surface of their own — a PR that re-splits a fused program regresses
+    # here long before boots/s shows it on a noisy CPU round
+    "device_dispatches": -1,
+    "executable_compiles": -1,
     "serving.qps": +1,
     "serving.cells_per_sec": +1,
     "serving.latency_p50_ms": -1,
     "serving.latency_p99_ms": -1,
     "serving.bucket_compiles": -1,
+}
+
+# Gate-spec shorthands: --gate compiles:0.9 reads better than the full
+# payload key; resolved before RUNGS lookup.
+RUNG_ALIASES: Dict[str, str] = {
+    "compiles": "executable_compiles",
+    "dispatches": "device_dispatches",
 }
 
 _JSON_LINE = re.compile(r"^\{.*\}$")
@@ -174,10 +190,12 @@ def parse_gates(specs: List[str]) -> List[Tuple[str, float]]:
         rung, sep, thresh = spec.partition(":")
         if not sep:
             raise BenchDiffError(1, f"--gate expects RUNG:MIN_FACTOR; got {spec!r}")
+        rung = RUNG_ALIASES.get(rung, rung)
         if rung not in RUNGS:
             raise BenchDiffError(
                 1, f"--gate names unknown rung {rung!r} "
-                   f"(known: {', '.join(sorted(RUNGS))})"
+                   f"(known: {', '.join(sorted(RUNGS))}; "
+                   f"aliases: {', '.join(sorted(RUNG_ALIASES))})"
             )
         try:
             gates.append((rung, float(thresh)))

@@ -28,7 +28,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2)
+KNOWN_SCHEMAS = (1, 2, 3)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -205,6 +205,35 @@ def serving(record: dict) -> str:
     return "\n".join(lines)
 
 
+def dispatch(record: dict) -> str:
+    """Dispatch/compile accounting table (obs schema >= 3): how many
+    top-level executables the run launched, how many shape buckets it
+    compiled, and what it donated in place. Records written before the
+    accounting existed render the placeholder line — every key access is
+    guarded, absence is normal (same contract as the serving table)."""
+    m = record.get("metrics") or {}
+    counters = m.get("counters") or {}
+    names = ("device_dispatches", "executable_compiles", "donated_bytes")
+    if not any(k in counters for k in names):
+        return "(no dispatch accounting)"
+    lines = []
+    for label, key in (
+        ("device dispatches", "device_dispatches"),
+        ("executable compiles", "executable_compiles"),
+        ("donated bytes", "donated_bytes"),
+    ):
+        if key in counters:
+            lines.append(f"{label:<28} {counters[key]:g}")
+    disp = counters.get("device_dispatches") or 0
+    comp = counters.get("executable_compiles") or 0
+    if disp and comp:
+        lines.append(f"{'dispatches per compile':<28} {disp / comp:.1f}")
+    boots = counters.get("boots_completed")
+    if boots and disp:
+        lines.append(f"{'boots per dispatch':<28} {boots / disp:.2f}")
+    return "\n".join(lines)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -240,6 +269,7 @@ def render(record: dict) -> str:
         "", "== span tree ==", flame(record),
         "", "== pipelining ==", pipelining(record),
         "", "== serving ==", serving(record),
+        "", "== dispatch ==", dispatch(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]

@@ -33,6 +33,16 @@ Dispatch accounting (obs schema v3): every rung also carries
 of the counting_jit counters (utils/compile_cache.py) across the rung, so
 tools/bench_diff.py can gate on program-count regressions
 (``--gate compiles:...``), not just boots/s.
+
+Resource accounting (obs schema v4, ISSUE 6): every rung also carries
+``peak_rss_mb`` / ``peak_device_mb`` (an obs/resource.py ResourceSampler
+brackets the whole bench process — on by default here at 50 ms, overridable
+via CCTPU_RESOURCE_SAMPLE_MS; peak_device_mb is null when the backend
+reports no memory stats) and ``est_flops`` (delta of the counting_jit
+``estimated_flops`` cost-model counter). ``tools/bench_diff.py --gate
+rss:...`` turns peak_rss_mb into the O1 peak-memory regression gate.
+BENCH_BALLAST_MB pins a deliberate host allocation for the run — the knob
+that proves the gate can see an O1-scale regression.
 """
 
 from __future__ import annotations
@@ -77,21 +87,28 @@ _RETRY_FLAG = "CCTPU_BENCH_CPU_RETRY"
 # verdict through CCTPU_BENCH_PROBE_* so it never re-probes either.
 _PROBE_CACHE: dict = {}
 
-_DISPATCH_KEYS = ("device_dispatches", "executable_compiles", "donated_bytes")
+# payload key -> process-global counter name (obs schema v3 dispatch
+# accounting + the v4 est_flops cost-model denominator)
+_DISPATCH_KEYS = {
+    "device_dispatches": "device_dispatches",
+    "executable_compiles": "executable_compiles",
+    "donated_bytes": "donated_bytes",
+    "est_flops": "estimated_flops",
+}
 
 
 def _dispatch_counters() -> dict:
-    """Current process-global dispatch-accounting counters (obs schema v3;
-    sourced by utils/compile_cache.counting_jit). Guarded: the failure rung
-    must emit even when the package cannot import."""
+    """Current process-global dispatch/cost-accounting counters (obs schema
+    v3/v4; sourced by utils/compile_cache.counting_jit). Guarded: the failure
+    rung must emit even when the package cannot import."""
     out = {k: 0 for k in _DISPATCH_KEYS}
     try:
         from consensusclustr_tpu.obs import global_metrics
 
         counters = global_metrics().counters
-        for k in _DISPATCH_KEYS:
-            if k in counters:
-                out[k] = int(counters[k].value)
+        for key, name in _DISPATCH_KEYS.items():
+            if name in counters:
+                out[key] = int(counters[name].value)
     except Exception:
         pass
     return out
@@ -99,6 +116,48 @@ def _dispatch_counters() -> dict:
 
 def _dispatch_delta(before: dict, after: dict) -> dict:
     return {k: max(0, after.get(k, 0) - before.get(k, 0)) for k in _DISPATCH_KEYS}
+
+
+def _start_resource_sampler():
+    """Bench-process ResourceSampler (obs/resource.py), started for the whole
+    measured run. On by default HERE (50 ms) — bench exists to measure, so it
+    opts in on behalf of the process; CCTPU_RESOURCE_SAMPLE_MS still
+    overrides (including "0"/"off"). None when the obs layer cannot import
+    (the failure rung then reports peak_rss_mb 0.0)."""
+    try:
+        from consensusclustr_tpu.obs.resource import (
+            ResourceSampler,
+            resolve_sample_ms,
+        )
+
+        ms = (
+            resolve_sample_ms(None)
+            if os.environ.get("CCTPU_RESOURCE_SAMPLE_MS")
+            else 50
+        )
+        return ResourceSampler(ms).start()
+    except Exception:
+        return None
+
+
+def _resource_rung(sampler) -> dict:
+    """Stop ``sampler`` and report its peaks — emitted on every rung
+    (including failure) so BENCH_*.json lines stay key-comparable and the
+    O1 memory gate always has a denominator."""
+    out = {"peak_rss_mb": 0.0, "peak_device_mb": None}
+    if sampler is None:
+        return out
+    try:
+        sampler.stop()
+        if not sampler.samples:  # sampling disabled: still take one reading
+            sampler.sample_now()
+        out["peak_rss_mb"] = round(sampler.peak_rss_bytes / 1e6, 1)
+        peak_dev = sampler.peak_device_bytes
+        if peak_dev is not None:
+            out["peak_device_mb"] = round(peak_dev / 1e6, 1)
+    except Exception:
+        pass
+    return out
 
 # The serving rung's zero shape — emitted verbatim on the failure rung so
 # BENCH_*.json lines stay key-comparable across PRs.
@@ -597,6 +656,14 @@ def main() -> None:
     # interpreter regains control between ops)
     _alarm(int(os.environ.get("BENCH_WATCHDOG_SECS", "1500")))
     dispatch0 = _dispatch_counters()
+    sampler = _start_resource_sampler()
+    # Deliberate host allocation (BENCH_BALLAST_MB): held for the whole rung
+    # so peak_rss_mb must rise by about this much — the self-test proving the
+    # memory gate can catch an O1-scale regression (tests/test_resource.py).
+    ballast = None
+    ballast_mb = int(os.environ.get("BENCH_BALLAST_MB", "0") or 0)
+    if ballast_mb > 0:
+        ballast = np.full(ballast_mb * 131072, 1.0)  # 131072 float64 = 1 MB
     try:
         payload = _run()
         if probe_outcome is not None:
@@ -605,6 +672,8 @@ def main() -> None:
         # value describe the workload, probe_s the environment's health check
         payload["probe_s"] = probe_s
         payload.update(_dispatch_delta(dispatch0, _dispatch_counters()))
+        payload.update(_resource_rung(sampler))
+        del ballast
         _emit(payload)
         _alarm(0)
         return
@@ -660,6 +729,7 @@ def main() -> None:
             "serving": dict(_SERVING_ZERO),
             "probe_s": probe_s,
             **_dispatch_delta(dispatch0, _dispatch_counters()),
+            **_resource_rung(sampler),
             "obs_schema": _OBS_SCHEMA,
         }
     )

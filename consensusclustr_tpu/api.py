@@ -845,6 +845,30 @@ def consensus_clust(
     log = LevelLog(enabled=cfg.progress, tracer=tracer)
     key = root_key(cfg.seed)
 
+    # Resource profiling (obs/resource.py): off unless cfg.resource_sample_ms
+    # / CCTPU_RESOURCE_SAMPLE_MS turns it on. The sampler covers the WHOLE
+    # run (every top-level span gets watermark attrs) and its series lands in
+    # the RunRecord below; the finally guarantees the daemon thread never
+    # outlives a failed run.
+    from consensusclustr_tpu.obs.resource import start_for as _start_sampler
+
+    sampler = _start_sampler(tracer, cfg.resource_sample_ms)
+    try:
+        return _consensus_clust_run(
+            counts, norm_counts, pca, cfg, tracer, log, key, sampler
+        )
+    finally:
+        if sampler is not None:
+            sampler.stop()
+
+
+def _consensus_clust_run(
+    counts, norm_counts, pca, cfg, tracer, log, key, sampler
+) -> ClusterResult:
+    """Body of :func:`consensus_clust` (split out so the resource sampler's
+    start/stop brackets the whole run without re-indenting the pipeline)."""
+    from consensusclustr_tpu.utils.backend import default_backend
+
     with tracer.span("ingest"):
         ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
     labels, cons, pca_used, fit_capture = _level(key, ing, cfg, log, depth=cfg.depth)
@@ -924,6 +948,8 @@ def consensus_clust(
             fit = ReferenceFit(stability=stability, **fit_capture)
 
     # --- run record (obs/): span tree + events + metrics snapshot ---------
+    if sampler is not None:
+        sampler.stop()  # closing watermark lands in the record's series
     record_device_memory(tracer.metrics)
     run_record = RunRecord.from_tracer(
         tracer, config=cfg, backend=default_backend()

@@ -159,6 +159,14 @@ class ClusterConfig:
     # a socket unless asked (docs/quirks.md). 0 = bind an ephemeral port
     # (the bound port is svc.metrics_port).
     serve_metrics_port: Optional[int] = None
+    # Resource profiling (obs/resource.py): background host-RSS +
+    # device-memory sampling interval in milliseconds. None resolves
+    # CCTPU_RESOURCE_SAMPLE_MS; unset/0 = OFF — the sampler thread never
+    # starts unless asked, so tests and library users pay zero overhead
+    # (docs/quirks.md "Observability schema v3 → v4"). When on, spans gain
+    # rss_peak_bytes/device_peak_bytes watermark attrs and the RunRecord
+    # carries the sample series (rendered as Perfetto counter tracks).
+    resource_sample_ms: Optional[int] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -198,6 +206,11 @@ class ClusterConfig:
             v = getattr(self, knob)
             if v is not None and int(v) < 1:
                 raise ValueError(f"{knob} must be >= 1; got {v}")
+        if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
+            raise ValueError(
+                f"resource_sample_ms must be >= 0 (0 = off); got "
+                f"{self.resource_sample_ms}"
+            )
         if self.serve_metrics_port is not None and not (
             0 <= int(self.serve_metrics_port) <= 65535
         ):

@@ -51,7 +51,8 @@ from consensusclustr_tpu.consensus.merge import (
     merge_small_clusters,
     merge_unstable_clusters,
 )
-from consensusclustr_tpu.obs import maybe_span, metrics_of
+from consensusclustr_tpu.obs import maybe_span, metrics_of, tracer_of
+from consensusclustr_tpu.obs.resource import resource_sampling
 from consensusclustr_tpu.parallel.pipelined import (
     AsyncChunkWriter,
     ChunkPipeline,
@@ -584,44 +585,53 @@ def consensus_cluster(
     accum = None
     if dense and cfg.nboots > 1 and not _pallas_wanted(cfg.use_pallas, cfg.max_clusters):
         accum = CoclusterAccumulator(n, cfg.max_clusters)
-    boot_labels, boot_scores = run_bootstraps(key, pca, cfg, log, accumulator=accum)
-    if dense:
-        with maybe_span(
-            log, "cocluster", dense=True, streamed=accum is not None
-        ) as sp:
-            if accum is not None:
-                dist = accum.distance()
-            else:
-                dist = coclustering_distance(
-                    jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
-                    use_pallas=cfg.use_pallas,
-                )
-            sp.value = dist
-        with maybe_span(log, "consensus_grid") as sp:
-            cons_labels, cons_scores = _consensus_grid(
-                key, dist, pca, res_list, k_list, cfg.max_clusters,
-                cluster_fun=cfg.cluster_fun,
-            )
-            sp.value = (cons_labels, cons_scores)
-        dist_np = np.asarray(dist)
-    else:
-        from consensusclustr_tpu.consensus.blockwise import (
-            blockwise_consensus_knn,
+    # Resource bracket (obs/resource.py): the boots + cocluster phases are
+    # where the O(n²) consensus memory materializes (ROADMAP O1), so sampling
+    # covers at least this region even for direct consensus_cluster callers
+    # (bench's granular rung, tests). An api-level sampler already attached
+    # to the tracer is reused and NOT stopped here — the bracket only stops
+    # what it itself started.
+    with resource_sampling(tracer_of(log), cfg.resource_sample_ms):
+        boot_labels, boot_scores = run_bootstraps(
+            key, pca, cfg, log, accumulator=accum
         )
+        if dense:
+            with maybe_span(
+                log, "cocluster", dense=True, streamed=accum is not None
+            ) as sp:
+                if accum is not None:
+                    dist = accum.distance()
+                else:
+                    dist = coclustering_distance(
+                        jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
+                        use_pallas=cfg.use_pallas,
+                    )
+                sp.value = dist
+            with maybe_span(log, "consensus_grid") as sp:
+                cons_labels, cons_scores = _consensus_grid(
+                    key, dist, pca, res_list, k_list, cfg.max_clusters,
+                    cluster_fun=cfg.cluster_fun,
+                )
+                sp.value = (cons_labels, cons_scores)
+            dist_np = np.asarray(dist)
+        else:
+            from consensusclustr_tpu.consensus.blockwise import (
+                blockwise_consensus_knn,
+            )
 
-        with maybe_span(log, "cocluster", dense=False) as sp:
-            knn_idx, _ = blockwise_consensus_knn(
-                jnp.asarray(boot_labels, jnp.int32), max(k_list), cfg.max_clusters,
-                use_pallas=cfg.use_pallas,
-            )
-            sp.value = knn_idx
-        with maybe_span(log, "consensus_grid") as sp:
-            cons_labels, cons_scores = _consensus_grid_from_knn(
-                key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
-                cluster_fun=cfg.cluster_fun,
-            )
-            sp.value = (cons_labels, cons_scores)
-        dist_np = None
+            with maybe_span(log, "cocluster", dense=False) as sp:
+                knn_idx, _ = blockwise_consensus_knn(
+                    jnp.asarray(boot_labels, jnp.int32), max(k_list),
+                    cfg.max_clusters, use_pallas=cfg.use_pallas,
+                )
+                sp.value = knn_idx
+            with maybe_span(log, "consensus_grid") as sp:
+                cons_labels, cons_scores = _consensus_grid_from_knn(
+                    key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
+                    cluster_fun=cfg.cluster_fun,
+                )
+                sp.value = (cons_labels, cons_scores)
+            dist_np = None
     labels = np.asarray(cons_labels)
     if log:
         log.event(

@@ -24,6 +24,13 @@ Chrome/Perfetto trace-event JSON (``RunRecord.to_chrome_trace`` /
 ``tools/report.py --trace``) and any metrics snapshot as Prometheus text
 (``MetricsRegistry.to_prom_text``, served live by ``AssignmentService`` when
 ``CCTPU_SERVE_METRICS_PORT`` enables the scrape endpoint).
+
+The resource layer (ISSUE 6 tentpole, ``obs/resource.py``) adds a background
+``ResourceSampler`` (host RSS + device memory, off by default via
+``CCTPU_RESOURCE_SAMPLE_MS`` / ``ClusterConfig.resource_sample_ms``): spans
+gain ``rss_peak_bytes``/``device_peak_bytes`` watermark attrs at close, the
+RunRecord carries the sample series (schema v4), and the Perfetto export
+renders it as ``ph:"C"`` counter tracks under the span lanes.
 """
 
 from consensusclustr_tpu.obs.export import (
@@ -47,6 +54,10 @@ from consensusclustr_tpu.obs.record import (
     config_fingerprint,
     load_records,
 )
+from consensusclustr_tpu.obs.resource import (
+    ResourceSampler,
+    resource_sampling,
+)
 from consensusclustr_tpu.obs.schema import (
     EVENT_KINDS,
     METRIC_NAMES,
@@ -67,6 +78,7 @@ __all__ = [
     "Histogram",
     "METRIC_NAMES",
     "MetricsRegistry",
+    "ResourceSampler",
     "RunRecord",
     "SCHEMA_VERSION",
     "SPAN_NAMES",
@@ -82,6 +94,7 @@ __all__ = [
     "metrics_of",
     "prom_text_from_snapshot",
     "record_device_memory",
+    "resource_sampling",
     "tracer_of",
     "write_chrome_trace",
 ]

@@ -6,8 +6,13 @@ Two operator-facing serializations of the obs/ state (ISSUE 4 tentpole):
     ``Span.to_dict``, or the ``spans`` of a persisted RunRecord) as
     trace-event JSON: ``ph: "X"`` complete events with microsecond ``ts`` /
     ``dur``, one ``tid`` lane per top-level phase name, span attrs as
-    ``args``, and the flat event stream as ``ph: "i"`` instants. The output
-    of :func:`write_chrome_trace` loads directly in ``ui.perfetto.dev`` /
+    ``args``, and the flat event stream as ``ph: "i"`` instants. A schema-v4
+    ``resource`` block (the obs/resource.py sampler series) additionally
+    renders as ``ph: "C"`` **counter tracks** — ``host_rss_mb``,
+    ``host_peak_rss_mb`` and (when the backend reports memory)
+    ``device_mb`` — clamped into the span lanes' time range so the memory
+    timeline lines up under the phases that caused it. The output of
+    :func:`write_chrome_trace` loads directly in ``ui.perfetto.dev`` /
     ``chrome://tracing``.
   * :func:`prom_text_from_snapshot` — a ``MetricsRegistry.snapshot()`` dict
     in the Prometheus text exposition format (version 0.0.4): ``# HELP`` /
@@ -62,11 +67,49 @@ def _us(seconds: float) -> int:
     return int(round(seconds * 1e6))
 
 
+def counter_track_events(
+    resource: dict, hi_us: Optional[int] = None
+) -> List[dict]:
+    """``ph: "C"`` counter events for a RunRecord ``resource`` block.
+
+    Two host tracks always (current RSS + running peak watermark, both MB)
+    plus a ``device_mb`` track when samples carry device bytes. Timestamps
+    are clamped into ``[0, hi_us]`` when given — the sampler keeps ticking
+    past the last span close, and counters dangling beyond the lanes would
+    stretch the viewport.
+    """
+    out: List[dict] = []
+    peak_mb = 0.0
+    for row in resource.get("samples") or ():
+        try:
+            t = float(row[0] or 0.0)
+            rss = float(row[1])
+            dev = row[2] if len(row) > 2 else None
+        except (TypeError, ValueError, IndexError):
+            continue
+        ts = max(0, _us(t))
+        if hi_us is not None:
+            ts = min(ts, hi_us)
+        mb = round(rss / 1e6, 3)
+        peak_mb = max(peak_mb, mb)
+        base = {"cat": "resource", "ph": "C", "ts": ts, "pid": TRACE_PID}
+        out.append({"name": "host_rss_mb", **base, "args": {"mb": mb}})
+        out.append({"name": "host_peak_rss_mb", **base, "args": {"mb": peak_mb}})
+        if dev is not None:
+            out.append({
+                "name": "device_mb", **base,
+                "args": {"mb": round(float(dev) / 1e6, 3)},
+            })
+    return out
+
+
 def chrome_trace_events(
     spans: Iterable[Any],
     events: Iterable[dict] = (),
+    resource: Optional[dict] = None,
 ) -> List[dict]:
-    """Trace-event list for a span tree (+ optional flat event stream).
+    """Trace-event list for a span tree (+ optional flat event stream and
+    resource-sampler counter tracks).
 
     Lanes: every distinct top-level span name gets its own ``tid`` (first-seen
     order, 1-based); descendants inherit the root's lane, so nesting renders
@@ -74,6 +117,8 @@ def chrome_trace_events(
     instants. Children are clamped into their parent's interval — span
     timestamps are rounded independently at capture time, and the trace
     contract (events on one tid must nest) is stricter than the tree's.
+    A ``resource`` block appends :func:`counter_track_events` clamped to the
+    span lanes' end.
     """
     out: List[dict] = [
         {
@@ -135,6 +180,11 @@ def chrome_trace_events(
         if args:
             rec["args"] = args
         out.append(rec)
+    if resource:
+        ends = [
+            e["ts"] + e.get("dur", 0) for e in out if e.get("ph") in ("X", "i")
+        ]
+        out.extend(counter_track_events(resource, max(ends) if ends else None))
     return out
 
 
@@ -142,10 +192,11 @@ def chrome_trace(
     spans: Iterable[Any],
     events: Iterable[dict] = (),
     metadata: Optional[dict] = None,
+    resource: Optional[dict] = None,
 ) -> dict:
     """The full trace-object form ({"traceEvents": [...]}) Perfetto loads."""
     doc = {
-        "traceEvents": chrome_trace_events(spans, events),
+        "traceEvents": chrome_trace_events(spans, events, resource=resource),
         "displayTimeUnit": "ms",
     }
     if metadata:
@@ -158,10 +209,13 @@ def write_chrome_trace(
     spans: Iterable[Any],
     events: Iterable[dict] = (),
     metadata: Optional[dict] = None,
+    resource: Optional[dict] = None,
 ) -> str:
     """Serialize :func:`chrome_trace` to ``path``; returns the path."""
     with open(path, "w") as f:
-        json.dump(chrome_trace(spans, events, metadata=metadata), f)
+        json.dump(
+            chrome_trace(spans, events, metadata=metadata, resource=resource), f
+        )
     return path
 
 

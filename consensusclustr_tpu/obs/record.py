@@ -78,6 +78,10 @@ class RunRecord:
     events: List[dict] = dataclasses.field(default_factory=list)
     metrics: dict = dataclasses.field(default_factory=dict)
     config: Optional[dict] = None
+    # schema v4: ResourceSampler series (obs/resource.py series_dict) —
+    # sample_ms, n_samples, rss/device peak watermarks, [t, rss, dev] rows.
+    # None on older records and on runs with sampling off (the default).
+    resource: Optional[dict] = None
 
     @classmethod
     def from_tracer(
@@ -93,6 +97,13 @@ class RunRecord:
 
             reg.merge(global_metrics())
         reg.merge(tracer.metrics)
+        sampler = getattr(tracer, "resource_sampler", None)
+        resource = None
+        if sampler is not None and getattr(sampler, "samples", None):
+            try:
+                resource = sampler.series_dict()
+            except Exception:
+                resource = None
         return cls(
             schema=SCHEMA_VERSION,
             backend=backend,
@@ -102,6 +113,7 @@ class RunRecord:
             events=list(tracer.events),
             metrics=reg.snapshot(),
             config=_config_dict(config),
+            resource=resource,
         )
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -113,7 +125,7 @@ class RunRecord:
         return out
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "schema": self.schema,
             "backend": self.backend,
             "config_fingerprint": self.config_fingerprint,
@@ -124,6 +136,9 @@ class RunRecord:
             "metrics": self.metrics,
             "config": self.config,
         }
+        if self.resource is not None:
+            d["resource"] = self.resource
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), default=_jsonable)
@@ -149,6 +164,7 @@ class RunRecord:
                 "config_fingerprint": self.config_fingerprint,
                 "wall_s": self.wall_s,
             },
+            resource=self.resource,
         )
 
     @classmethod
@@ -162,6 +178,7 @@ class RunRecord:
             events=list(d.get("events", [])),
             metrics=dict(d.get("metrics", {})),
             config=d.get("config"),
+            resource=d.get("resource"),
         )
 
 

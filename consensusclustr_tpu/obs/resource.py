@@ -1,0 +1,372 @@
+"""Resource profiling: host-RSS + device-memory sampling with per-phase
+watermark attribution (ISSUE 6 tentpole).
+
+The dense consensus accumulator is O(n²) host/device memory (ROADMAP O1:
+6.9 GB RSS at 50k cells, ~2.7 TB extrapolated at 1M) — but until this module
+the obs layer could not *see* memory: device ``memory_stats()`` was a
+one-shot gauge pair and host RSS lived in an ad-hoc ``getrusage`` call.
+:class:`ResourceSampler` closes that gap:
+
+  * a background daemon thread samples host RSS (``/proc/self/statm``,
+    stdlib + psutil-free, with a ``getrusage`` maxrss fallback on platforms
+    without procfs — documented as a peak, not a current value) and the
+    first local device's ``memory_stats()`` on a configurable interval;
+  * every sample lands in a bounded time series of
+    ``(t, rss_bytes, device_bytes_in_use)`` tuples (decimated 2:1 past
+    ``CCTPU_RESOURCE_MAX_SAMPLES`` so week-long runs stay bounded) and
+    updates the ``host_rss_bytes`` / ``host_peak_rss_bytes`` /
+    ``device_bytes_in_use`` / ``device_peak_bytes_in_use`` gauges plus the
+    ``resource_samples`` counter;
+  * attached to a :class:`~consensusclustr_tpu.obs.tracer.Tracer`, a
+    span-close hook stamps per-phase **watermarks** — the peak RSS/device
+    bytes observed while the span ran — as ``rss_peak_bytes`` /
+    ``device_peak_bytes`` span attrs (registered in
+    ``obs.schema.RESOURCE_SPAN_ATTRS``), which is what ``tools/report.py``'s
+    "== memory ==" table and the O1 peak-memory bench gate consume;
+  * the series serializes into ``RunRecord.resource`` (schema v4) and
+    ``obs/export.py`` renders it as Perfetto ``ph:"C"`` counter tracks
+    alongside the span lanes.
+
+Sampling is **off by default** (interval 0): tests and library users pay
+zero overhead unless ``ClusterConfig.resource_sample_ms`` or
+``$CCTPU_RESOURCE_SAMPLE_MS`` turns it on. The device read never initializes
+a backend the process hasn't already brought up — a wedged TPU tunnel would
+otherwise hang the sampler thread inside a C call.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry, global_metrics
+
+# Span attrs stamped at close time; the literal values are validated against
+# obs.schema.RESOURCE_SPAN_ATTRS by tools/check_obs_schema.py.
+RSS_PEAK_ATTR = "rss_peak_bytes"
+DEVICE_PEAK_ATTR = "device_peak_bytes"
+
+DEFAULT_MAX_SAMPLES = 4096
+
+_PAGE_SIZE: Optional[int] = None
+
+
+def resolve_sample_ms(requested: Optional[int] = None) -> int:
+    """Explicit arg > $CCTPU_RESOURCE_SAMPLE_MS > 0 (off).
+
+    0 (or "off"/"none" in the env var) disables sampling entirely — the
+    default, so the sampler is opt-in everywhere (docs/quirks.md).
+    """
+    if requested is None:
+        env = os.environ.get("CCTPU_RESOURCE_SAMPLE_MS", "").strip().lower()
+        if env in ("", "off", "none"):
+            return 0
+        requested = env
+    v = int(requested)
+    if v < 0:
+        raise ValueError(
+            f"resource_sample_ms must be >= 0 (0 = off); got {v}"
+        )
+    return v
+
+
+def host_rss_bytes() -> int:
+    """Current host resident-set size in bytes.
+
+    ``/proc/self/statm`` field 2 (resident pages) x page size on Linux; the
+    ``resource.getrusage`` ru_maxrss fallback elsewhere is a *peak*, not a
+    current value — still monotone-correct for watermarks. 0 when neither
+    source exists (the sampler then records an honest zero, never raises).
+    """
+    global _PAGE_SIZE
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            fields = f.read().split()
+        if _PAGE_SIZE is None:
+            _PAGE_SIZE = int(os.sysconf("SC_PAGE_SIZE"))
+        return int(fields[1]) * _PAGE_SIZE
+    except Exception:
+        pass
+    try:
+        import resource as _resource
+
+        # ru_maxrss is KB on Linux (moot: statm exists there), bytes on macOS
+        v = int(_resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss)
+        return v if sys.platform == "darwin" else v * 1024
+    except Exception:
+        return 0
+
+
+def device_memory_bytes() -> Tuple[Optional[int], Optional[int]]:
+    """(bytes_in_use, peak_bytes_in_use) of the first local device, or
+    (None, None) when unavailable (no jax, backend not yet initialized,
+    XLA:CPU's empty stats). Deliberately refuses to *initialize* a backend:
+    ``jax.local_devices()`` on a wedged serving tunnel hangs inside a C call
+    where no timeout can reach, and a profiling thread must never be the
+    thing that dials the accelerator first.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return (None, None)
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return (None, None)  # process hasn't touched a backend yet
+    except Exception:
+        pass  # private-API drift: fall through to the guarded call
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return (None, None)
+    if not stats:
+        return (None, None)
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    return (
+        int(in_use) if in_use is not None else None,
+        int(peak) if peak is not None else None,
+    )
+
+
+class ResourceSampler:
+    """Background host-RSS + device-memory sampler with span attribution.
+
+    Lifecycle: ``start()`` takes one immediate sample (short runs always get
+    a watermark) and spawns the daemon thread; ``stop()`` joins it and takes
+    a closing sample; both are idempotent and a stopped sampler can be
+    restarted (the series keeps accumulating — one sampler per Tracer even
+    across recursion levels). ``sample_ms <= 0`` disables everything:
+    ``start()`` is a no-op and the series stays empty.
+
+    Thread safety: the sample list and peaks are lock-guarded (writer: the
+    sampler thread; readers: span-close hooks on the pipeline thread and
+    RunRecord serialization). Gauge updates ride the metrics registry's own
+    conventions (one writer per instrument).
+    """
+
+    def __init__(
+        self,
+        sample_ms: Optional[int] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        epoch: Optional[float] = None,
+        max_samples: Optional[int] = None,
+    ) -> None:
+        self.sample_ms = resolve_sample_ms(sample_ms)
+        self.metrics = metrics
+        self.epoch = time.monotonic() if epoch is None else float(epoch)
+        self.max_samples = int(
+            max_samples
+            if max_samples is not None
+            else os.environ.get("CCTPU_RESOURCE_MAX_SAMPLES", DEFAULT_MAX_SAMPLES)
+        )
+        # (t_seconds_since_epoch, rss_bytes, device_bytes_in_use_or_None),
+        # strictly time-ordered (single appender + lock)
+        self.samples: List[Tuple[float, int, Optional[int]]] = []
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._peak_rss = 0
+        self._peak_device: Optional[int] = None
+        # decimation doubles the effective interval so the series stays
+        # bounded without losing the envelope of long runs
+        self._effective_ms = max(self.sample_ms, 1)
+        self._attached: List[Any] = []
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.sample_ms > 0
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def peak_rss_bytes(self) -> int:
+        return self._peak_rss
+
+    @property
+    def peak_device_bytes(self) -> Optional[int]:
+        return self._peak_device
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample_now(self) -> Tuple[float, int, Optional[int]]:
+        """Take one sample immediately (also valid while stopped): appends to
+        the series, advances the peak watermarks, refreshes the gauges."""
+        t = round(time.monotonic() - self.epoch, 4)
+        rss = host_rss_bytes()
+        dev, dev_peak = device_memory_bytes()
+        with self._lock:
+            self.samples.append((t, rss, dev))
+            if len(self.samples) >= self.max_samples:
+                self.samples = self.samples[::2]
+                self._effective_ms *= 2
+            self._peak_rss = max(self._peak_rss, rss)
+            if dev is not None:
+                cand = max(dev, dev_peak if dev_peak is not None else dev)
+                self._peak_device = (
+                    cand
+                    if self._peak_device is None
+                    else max(self._peak_device, cand)
+                )
+        mets = self.metrics if self.metrics is not None else global_metrics()
+        mets.counter("resource_samples").inc()
+        mets.gauge("host_rss_bytes").set(rss)
+        mets.gauge("host_peak_rss_bytes").set(self._peak_rss)
+        if dev is not None:
+            mets.gauge("device_bytes_in_use").set(dev)
+            mets.gauge("device_peak_bytes_in_use").set(self._peak_device)
+        return (t, rss, dev)
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self._effective_ms / 1000.0):
+            try:
+                self.sample_now()
+            except Exception:
+                pass  # profiling must never kill the run
+
+    def start(self) -> "ResourceSampler":
+        if not self.enabled or self.running:
+            return self
+        self._stop_event.clear()
+        try:
+            self.sample_now()  # short spans still see >= 1 sample
+        except Exception:
+            pass
+        self._thread = threading.Thread(
+            target=self._loop, name="cctpu-resource-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> "ResourceSampler":
+        stopped_thread = self._thread is not None
+        if stopped_thread:
+            self._stop_event.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if stopped_thread and self.enabled:
+            try:
+                self.sample_now()  # closing watermark (once per start/stop)
+            except Exception:
+                pass
+        return self
+
+    def __enter__(self) -> "ResourceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- span attribution ----------------------------------------------------
+
+    def attach(self, tracer: Any) -> "ResourceSampler":
+        """Bind to a Tracer: adopt its epoch (so sample ``t`` aligns with
+        span ``t0``) and metrics registry, register the span-close watermark
+        hook, and expose self as ``tracer.resource_sampler`` (where
+        ``RunRecord.from_tracer`` picks the series up). Idempotent."""
+        if tracer is None or tracer in self._attached:
+            return self
+        if self.metrics is None:
+            self.metrics = tracer.metrics
+        if not self.samples:
+            self.epoch = tracer.epoch
+        tracer.resource_sampler = self
+        tracer.add_span_close_hook(self._on_span_close)
+        self._attached.append(tracer)
+        return self
+
+    def _window(
+        self, t0: float, t1: float
+    ) -> List[Tuple[float, int, Optional[int]]]:
+        with self._lock:
+            lo = bisect.bisect_left(self.samples, (t0,))
+            hi = bisect.bisect_right(
+                self.samples, (t1, float("inf"), float("inf"))
+            )
+            return self.samples[lo:hi]
+
+    def _on_span_close(self, span: Any) -> None:
+        """Stamp the peak RSS/device watermark observed while ``span`` was
+        open. Spans shorter than the interval force one sample at close so
+        every phase gets attributed."""
+        if not self.enabled:
+            return
+        t0 = float(span.t0)
+        t1 = t0 + float(span.seconds or 0.0)
+        window = self._window(t0, t1)
+        if not window:
+            if not self.running and not self.samples:
+                return  # never started: stay silent, not half-attributed
+            try:
+                window = [self.sample_now()]
+            except Exception:
+                return
+        span.attrs[RSS_PEAK_ATTR] = int(max(s[1] for s in window))
+        device = [s[2] for s in window if s[2] is not None]
+        if device:
+            span.attrs[DEVICE_PEAK_ATTR] = int(max(device))
+
+    # -- serialization -------------------------------------------------------
+
+    def series_dict(self) -> dict:
+        """JSON-able summary for ``RunRecord.resource`` (schema v4): the
+        bounded sample series plus the run-wide peak watermarks."""
+        with self._lock:
+            samples = list(self.samples)
+        return {
+            "sample_ms": self.sample_ms,
+            "n_samples": len(samples),
+            "rss_peak_bytes": int(self._peak_rss),
+            "device_peak_bytes": (
+                int(self._peak_device) if self._peak_device is not None else None
+            ),
+            "samples": [
+                [t, int(rss), int(dev) if dev is not None else None]
+                for t, rss, dev in samples
+            ],
+        }
+
+
+def start_for(tracer: Any, sample_ms: Optional[int] = None) -> Optional[ResourceSampler]:
+    """Attach + start a sampler on ``tracer`` when the resolved interval is
+    on; None otherwise. The caller owns the matching ``stop()`` (api.py wraps
+    the run in try/finally)."""
+    if tracer is None or resolve_sample_ms(sample_ms) <= 0:
+        return None
+    return ResourceSampler(sample_ms, epoch=tracer.epoch).attach(tracer).start()
+
+
+@contextlib.contextmanager
+def resource_sampling(tracer: Any, sample_ms: Optional[int] = None):
+    """Bracket a region with resource sampling on ``tracer``.
+
+    Reuses the tracer's existing sampler when one is attached (restarting it
+    if a previous bracket stopped it — recursion levels keep extending one
+    series) and only stops what this call itself started, so an outer
+    api-level sampler keeps running across inner pipeline brackets. Yields
+    the sampler, or None when sampling is off.
+    """
+    sampler = getattr(tracer, "resource_sampler", None) if tracer is not None else None
+    if sampler is None:
+        if tracer is None or resolve_sample_ms(sample_ms) <= 0:
+            yield None
+            return
+        sampler = ResourceSampler(sample_ms, epoch=tracer.epoch).attach(tracer)
+    started = False
+    if sampler.enabled and not sampler.running:
+        sampler.start()
+        started = True
+    try:
+        yield sampler
+    finally:
+        if started:
+            sampler.stop()

@@ -24,7 +24,16 @@ from __future__ import annotations
 # ``executable_compiles`` and ``donated_bytes`` counters (sourced by
 # utils/compile_cache.counting_jit, emitted per bench rung, rendered by
 # tools/report.py's "== dispatch ==" table). See docs/quirks.md.
-SCHEMA_VERSION = 3
+# v4 (ISSUE 6): resource profiling — RunRecord gained the optional
+# ``resource`` block (the obs/resource.py ResourceSampler time series of
+# (t, rss_bytes, device_bytes) samples), spans carry per-phase
+# ``rss_peak_bytes``/``device_peak_bytes`` watermark attrs
+# (RESOURCE_SPAN_ATTRS below, stamped by the sampler's span-close hook),
+# the Perfetto export renders the series as ``ph:"C"`` counter tracks,
+# and counting_jit harvests XLA cost_analysis into the
+# ``estimated_flops``/``estimated_bytes_accessed`` counters. See
+# docs/quirks.md "Observability schema v3 → v4".
+SCHEMA_VERSION = 4
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -120,7 +129,23 @@ METRIC_HELP = {
     "device_dispatches": "counter: top-level pipeline executable launches (counting_jit-wrapped entry programs)",
     "executable_compiles": "counter: traces of top-level entry programs (one per shape bucket)",
     "donated_bytes": "counter: bytes of operand buffers donated for in-place executable updates",
+    # resource profiling (obs/resource.py ResourceSampler, ISSUE 6)
+    "host_rss_bytes": "gauge: host resident-set size at the last resource sample (/proc/self/statm)",
+    "host_peak_rss_bytes": "gauge: peak host RSS watermark observed by the resource sampler",
+    "resource_samples": "counter: resource-sampler ticks taken (host RSS + device memory reads)",
+    # cost-model accounting (utils/compile_cache.counting_jit, ISSUE 6)
+    "estimated_flops": "counter: summed one-execution XLA cost_analysis flops of compiled entry programs",
+    "estimated_bytes_accessed": "counter: summed one-execution XLA cost_analysis bytes accessed of compiled entry programs",
 }
 
 # Metrics registry names (counters, gauges, histograms).
 METRIC_NAMES = frozenset(METRIC_HELP)
+
+# Span attrs stamped by the ResourceSampler's span-close hook
+# (obs/resource.py). tools/check_obs_schema.py validates the *_ATTR literals
+# defined there against this set, both directions — a renamed watermark attr
+# is a test failure, not a silently empty "== memory ==" table.
+RESOURCE_SPAN_ATTRS = frozenset({
+    "rss_peak_bytes",     # peak host RSS (bytes) observed while the span ran
+    "device_peak_bytes",  # peak device bytes_in_use while the span ran
+})

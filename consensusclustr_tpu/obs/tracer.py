@@ -90,6 +90,15 @@ class Tracer:
         self.events: List[dict] = []
         self.epoch = time.monotonic()
         self._stack: List[Span] = []
+        # span-close hooks (obs/resource.py watermark attribution): called
+        # with the closed Span after ``seconds`` is set; exceptions swallowed
+        self._span_close_hooks: List[Any] = []
+
+    def add_span_close_hook(self, fn: Any) -> None:
+        """Register ``fn(span)`` to run whenever a span closes (after its
+        ``seconds`` is final, before the stack pops) — the ResourceSampler
+        uses this to stamp per-phase memory watermark attrs."""
+        self._span_close_hooks.append(fn)
 
     # -- spans ---------------------------------------------------------------
 
@@ -134,6 +143,11 @@ class Tracer:
                     ann.__exit__(None, None, None)
                 except Exception:
                     pass
+            for hook in self._span_close_hooks:
+                try:
+                    hook(sp)
+                except Exception:
+                    pass  # observability must never fail the traced work
             self._stack.pop()
             if not self._stack:
                 # top-level phase timings ride the bucketed histogram path so

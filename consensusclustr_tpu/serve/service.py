@@ -36,6 +36,15 @@ stdlib ``http.server`` daemon thread serves ``/metrics`` (Prometheus text via
 count, drain state as JSON) on localhost. The exporter starts with
 ``start()``, survives the drain, and closes with ``close()``.
 
+Resource profiling (ISSUE 6): when ``resource_sample_ms`` /
+``ClusterConfig.resource_sample_ms`` / ``CCTPU_RESOURCE_SAMPLE_MS`` names an
+interval (default OFF), an obs/resource.py ``ResourceSampler`` attached to
+the service tracer samples host RSS + device memory for the service's whole
+lifetime — it starts with ``start()``, keeps ticking through the drain (a
+scrape mid-shutdown sees live ``host_rss_bytes`` / ``host_peak_rss_bytes``
+gauges on ``/metrics``), and stops last in ``close()`` so the final sample
+is the service's closing watermark.
+
 Knob resolution follows the package's env-override pattern
 (parallel/pipelined.pipeline_depth): explicit argument >
 ``ClusterConfig.serve_*`` field > ``CCTPU_SERVE_*`` env var > default.
@@ -211,6 +220,7 @@ class AssignmentService:
         start: bool = True,
         tracer: Optional[Tracer] = None,
         metrics_port: Optional[int] = None,
+        resource_sample_ms: Optional[int] = None,
     ) -> None:
         if mode not in ("robust", "granular"):
             raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
@@ -249,6 +259,18 @@ class AssignmentService:
         )
         self._http: Optional[_MetricsHTTPServer] = None
         self.metrics_port: Optional[int] = None  # bound port once started
+        # Resource sampler (obs/resource.py): inert when the resolved
+        # interval is 0 (the default) — no thread, no samples, no gauges.
+        from consensusclustr_tpu.obs.resource import ResourceSampler
+
+        self.resource_sampler = ResourceSampler(
+            resource_sample_ms
+            if resource_sample_ms is not None
+            else getattr(cfg, "resource_sample_ms", None),
+            epoch=self.tracer.epoch,
+        )
+        if self.resource_sampler.enabled:
+            self.resource_sampler.attach(self.tracer)
         self._accepted = 0
         self._completed = 0
         if warmup:
@@ -305,6 +327,7 @@ class AssignmentService:
             self._http = _MetricsHTTPServer(self, self._metrics_port_req)
             self.metrics_port = self._http.port
             self.tracer.event("serve_metrics", port=self.metrics_port)
+        self.resource_sampler.start()  # no-op when sampling is off
 
     def close(self) -> None:
         """Stop intake, drain everything queued, join the worker."""
@@ -333,6 +356,9 @@ class AssignmentService:
         if self._http is not None:
             self._http.close()
             self._http = None
+        # the sampler outlives both the drain AND the exporter: its closing
+        # sample is the service's final memory watermark
+        self.resource_sampler.stop()
 
     def __enter__(self) -> "AssignmentService":
         return self

@@ -46,25 +46,70 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
       * ``donated_bytes`` — bytes of operand buffers handed to the executable
         via ``donate_argnums`` per dispatch (in-place carry updates: the
         consensus accumulator, per-chunk key/index slices).
+      * ``estimated_flops`` / ``estimated_bytes_accessed`` (ISSUE 6) — XLA
+        ``cost_analysis`` of each freshly traced shape bucket, one execution's
+        worth per compile. Harvested from the *lowered* (pre-optimization)
+        HLO — no second backend compile — and tolerant of backends that
+        report nothing: the counters simply stay at 0. This is O4's
+        FLOP/byte denominator next to the dispatch counts.
 
     The counters cover exactly the functions wrapped here — the per-boot hot
     path and its chunk drivers — not every small jit in the package, so
     bench deltas are stable, gateable program counts (tools/bench_diff.py
-    ``--gate compiles:...``).
+    ``--gate compiles:...`` / ``--gate rss:...``).
     """
     if fun is None:
         return functools.partial(
             counting_jit, donate_argnums=donate_argnums, **jit_kwargs
         )
     donate = tuple(donate_argnums)
+    in_harvest = [False]  # cost-harvest re-lowering must not count as a compile
 
     @functools.wraps(fun)
     def _traced(*args, **kwargs):
         # runs once per jit cache entry (trace time), not per call
-        global_metrics().counter("executable_compiles").inc()
+        if not in_harvest[0]:
+            global_metrics().counter("executable_compiles").inc()
         return fun(*args, **kwargs)
 
     jitted = jax.jit(_traced, donate_argnums=donate, **jit_kwargs)
+
+    def _harvest_cost(args, kwargs) -> None:
+        # One fresh (shape, static-args) cache entry just traced: re-lower on
+        # abstract shapes (donated operands may already be deleted — avals
+        # survive deletion) and fold the pre-optimization HLO cost analysis
+        # into the cost-model counters. One extra trace per shape bucket,
+        # never a second backend compile; any failure (backend reports
+        # nothing, AOT API drift) leaves the counters untouched. The extra
+        # trace is skippable with CCTPU_NO_COST_ANALYSIS for hosts where even
+        # once-per-bucket re-tracing is too much.
+        if os.environ.get("CCTPU_NO_COST_ANALYSIS"):
+            return
+        try:
+            def _aval(leaf):
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+                return leaf
+
+            sds = jax.tree_util.tree_map(_aval, (args, kwargs))
+            in_harvest[0] = True
+            try:
+                cost = jitted.lower(*sds[0], **sds[1]).cost_analysis()
+            finally:
+                in_harvest[0] = False
+        except Exception:
+            return
+        mets = global_metrics()
+        for entry in cost if isinstance(cost, (list, tuple)) else (cost,):
+            if not isinstance(entry, dict):
+                continue
+            for counter, key in (
+                ("estimated_flops", "flops"),
+                ("estimated_bytes_accessed", "bytes accessed"),
+            ):
+                v = entry.get(key)
+                if v is not None and float(v) > 0:
+                    mets.counter(counter).inc(float(v))
 
     @functools.wraps(fun)
     def wrapper(*args, **kwargs):
@@ -80,7 +125,19 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
                     for leaf in jax.tree_util.tree_leaves(args[i]):
                         nbytes += int(getattr(leaf, "nbytes", 0) or 0)
             mets.counter("donated_bytes").inc(nbytes)
-        return jitted(*args, **kwargs)
+        try:
+            size_before = jitted._cache_size()
+        except Exception:
+            size_before = None
+        out = jitted(*args, **kwargs)
+        if size_before is not None:
+            try:
+                fresh_compile = jitted._cache_size() > size_before
+            except Exception:
+                fresh_compile = False
+            if fresh_compile:
+                _harvest_cost(args, kwargs)
+        return out
 
     wrapper._counting_jitted = jitted  # escape hatch (lower/AOT, tests)
     # preserve the jax.jit introspection surface callers already rely on

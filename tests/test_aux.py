@@ -333,12 +333,14 @@ class TestBenchProbeBudget:
     def test_dispatch_delta_shape(self):
         bench = self._bench()
         before = {"device_dispatches": 3, "executable_compiles": 1,
-                  "donated_bytes": 100}
+                  "donated_bytes": 100, "est_flops": 1000}
         after = {"device_dispatches": 7, "executable_compiles": 1,
-                 "donated_bytes": 400}
+                 "donated_bytes": 400, "est_flops": 5000}
         delta = bench._dispatch_delta(before, after)
         assert delta == {"device_dispatches": 4, "executable_compiles": 0,
-                         "donated_bytes": 300}
-        # live counters carry every key the payload contract names
+                         "donated_bytes": 300, "est_flops": 4000}
+        # live counters carry every key the payload contract names (the v4
+        # est_flops cost rung included)
         live = bench._dispatch_counters()
         assert set(live) == set(bench._DISPATCH_KEYS)
+        assert "est_flops" in live

@@ -1,0 +1,671 @@
+"""Resource profiling layer (obs/resource.py, ISSUE 6): sampler lifecycle,
+per-phase watermark attribution, Perfetto counter tracks, cost-model
+counters, and the memory rungs of bench/bench_diff.
+
+Covers the ISSUE 6 checklist: zero samples when disabled (the default),
+clean start/stop with pipeline completion and AssignmentService.close(),
+monotone peak watermarks, a deliberate 256 MB host allocation measurably
+raising the peak (the O1-gate proof), counter-track events present and
+clamped inside the trace's time range, the schema-v4 RunRecord resource
+block, tools/report.py's "== memory ==" table, check_obs_schema's span-attr
+validation, and bench_diff's lower-is-better memory rungs + --gate rss
+alias.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.obs import (
+    MetricsRegistry,
+    ResourceSampler,
+    RunRecord,
+    SCHEMA_VERSION,
+    Tracer,
+    resource_sampling,
+)
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.obs.resource import (
+    DEVICE_PEAK_ATTR,
+    RSS_PEAK_ATTR,
+    host_rss_bytes,
+    resolve_sample_ms,
+    start_for,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -----------------------------------------------------------------------------
+# interval resolution + host probes
+# -----------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_RESOURCE_SAMPLE_MS", raising=False)
+        assert resolve_sample_ms(None) == 0
+        assert not ResourceSampler().enabled
+
+    def test_env_and_explicit(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_RESOURCE_SAMPLE_MS", "25")
+        assert resolve_sample_ms(None) == 25
+        assert resolve_sample_ms(10) == 10  # explicit beats env
+        monkeypatch.setenv("CCTPU_RESOURCE_SAMPLE_MS", "off")
+        assert resolve_sample_ms(None) == 0
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_sample_ms(-1)
+        from consensusclustr_tpu.config import ClusterConfig
+
+        with pytest.raises(ValueError):
+            ClusterConfig(resource_sample_ms=-5)
+        assert ClusterConfig(resource_sample_ms=0).resource_sample_ms == 0
+
+    def test_host_rss_positive(self):
+        # /proc/self/statm on Linux, getrusage elsewhere — a running
+        # interpreter is never 0 bytes resident
+        assert host_rss_bytes() > 1_000_000
+
+
+# -----------------------------------------------------------------------------
+# sampler lifecycle
+# -----------------------------------------------------------------------------
+
+
+class TestSamplerLifecycle:
+    def test_disabled_sampler_takes_zero_samples(self):
+        s = ResourceSampler(0)
+        assert s.start() is s          # no-op
+        assert not s.running
+        time.sleep(0.02)
+        s.stop()
+        assert s.samples == []
+        assert s.peak_rss_bytes == 0
+
+    def test_start_stop_accumulates_and_is_idempotent(self):
+        s = ResourceSampler(5)
+        s.start()
+        assert s.running
+        s.start()                      # idempotent
+        time.sleep(0.08)
+        s.stop()
+        assert not s.running
+        n = len(s.samples)
+        assert n >= 2                  # immediate sample + closing sample
+        s.stop()                       # idempotent: no thread, no new sample
+        assert len(s.samples) == n
+        # restart keeps extending the one series
+        s.start()
+        time.sleep(0.03)
+        s.stop()
+        assert len(s.samples) > n
+
+    def test_peak_watermark_is_monotone(self):
+        s = ResourceSampler(5)
+        peaks = []
+        for _ in range(6):
+            s.sample_now()
+            peaks.append(s.peak_rss_bytes)
+        assert peaks == sorted(peaks)
+        assert peaks[-1] >= max(r for _, r, _ in s.samples)
+
+    def test_series_is_time_ordered_and_bounded(self):
+        s = ResourceSampler(1, max_samples=8)
+        for _ in range(20):
+            s.sample_now()
+        times = [t for t, _, _ in s.samples]
+        assert times == sorted(times)
+        assert len(s.samples) < 16     # decimation kept it bounded
+
+    def test_ballast_raises_peak(self):
+        """The O1-gate proof at mechanism level: a deliberate 256 MB host
+        allocation must measurably raise the sampler's peak watermark —
+        exactly what BENCH_BALLAST_MB does to a bench rung's peak_rss_mb."""
+        s = ResourceSampler(5)
+        s.sample_now()
+        before = s.peak_rss_bytes
+        ballast = np.full(256 * 131072, 1.0)  # 256 MB of touched float64
+        s.sample_now()
+        after = s.peak_rss_bytes
+        del ballast
+        assert after - before > 200 * 1e6, (before, after)
+
+    def test_gauges_updated(self):
+        reg = MetricsRegistry()
+        s = ResourceSampler(5, metrics=reg)
+        s.sample_now()
+        assert reg.counters["resource_samples"].value == 1
+        assert reg.gauges["host_rss_bytes"].value > 0
+        assert (
+            reg.gauges["host_peak_rss_bytes"].value
+            >= reg.gauges["host_rss_bytes"].value * 0.5
+        )
+
+
+# -----------------------------------------------------------------------------
+# span attribution
+# -----------------------------------------------------------------------------
+
+
+class TestSpanAttribution:
+    def test_closed_spans_carry_watermarks(self):
+        tracer = Tracer()
+        s = ResourceSampler(2, epoch=tracer.epoch).attach(tracer)
+        s.start()
+        with tracer.span("boots"):
+            time.sleep(0.03)
+            with tracer.span("cocluster"):
+                time.sleep(0.02)
+        s.stop()
+        boots = tracer.roots[0]
+        assert boots.attrs[RSS_PEAK_ATTR] > 1_000_000
+        child = boots.children[0]
+        assert child.attrs[RSS_PEAK_ATTR] > 1_000_000
+        # child watermark is a max over a sub-interval of the parent's
+        assert child.attrs[RSS_PEAK_ATTR] <= boots.attrs[RSS_PEAK_ATTR]
+
+    def test_short_span_forces_a_sample(self):
+        tracer = Tracer()
+        s = ResourceSampler(10_000, epoch=tracer.epoch).attach(tracer)
+        s.start()  # interval far longer than the span
+        with tracer.span("merge"):
+            pass
+        s.stop()
+        assert tracer.roots[0].attrs[RSS_PEAK_ATTR] > 0
+
+    def test_detached_tracer_spans_untouched(self):
+        tracer = Tracer()
+        with tracer.span("boots"):
+            pass
+        assert RSS_PEAK_ATTR not in tracer.roots[0].attrs
+
+    def test_attr_literals_registered_in_schema(self):
+        assert RSS_PEAK_ATTR in obs_schema.RESOURCE_SPAN_ATTRS
+        assert DEVICE_PEAK_ATTR in obs_schema.RESOURCE_SPAN_ATTRS
+
+    def test_start_for_off_returns_none(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_RESOURCE_SAMPLE_MS", raising=False)
+        assert start_for(Tracer()) is None
+
+    def test_resource_sampling_bracket_stops_what_it_started(self):
+        tracer = Tracer()
+        with resource_sampling(tracer, 5) as s:
+            assert s is not None and s.running
+            with tracer.span("boots"):
+                time.sleep(0.02)
+        assert not s.running
+        # an outer sampler survives an inner bracket
+        outer = start_for(tracer, 5)
+        with resource_sampling(tracer, 5) as inner:
+            assert inner is outer
+        assert outer.running
+        outer.stop()
+
+    def test_resource_sampling_off_yields_none(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_RESOURCE_SAMPLE_MS", raising=False)
+        with resource_sampling(Tracer(), None) as s:
+            assert s is None
+
+
+# -----------------------------------------------------------------------------
+# RunRecord resource block + Perfetto counter tracks
+# -----------------------------------------------------------------------------
+
+
+def _sampled_record():
+    tracer = Tracer()
+    sampler = ResourceSampler(2, epoch=tracer.epoch).attach(tracer)
+    sampler.start()
+    with tracer.span("boots"):
+        time.sleep(0.03)
+    with tracer.span("cocluster"):
+        time.sleep(0.02)
+    sampler.stop()
+    return RunRecord.from_tracer(tracer, include_global_metrics=False)
+
+
+class TestRecordAndTrace:
+    def test_record_carries_resource_block_and_roundtrips(self, tmp_path):
+        rec = _sampled_record()
+        assert rec.schema == SCHEMA_VERSION >= 4
+        assert rec.resource is not None
+        assert rec.resource["n_samples"] == len(rec.resource["samples"]) > 0
+        assert rec.resource["rss_peak_bytes"] > 1_000_000
+        path = str(tmp_path / "rr.jsonl")
+        rec.write(path)
+        back = RunRecord.from_dict(json.loads(open(path).read()))
+        assert back.resource == json.loads(json.dumps(rec.resource))
+
+    def test_record_without_sampler_has_no_resource(self):
+        tracer = Tracer()
+        with tracer.span("boots"):
+            pass
+        rec = RunRecord.from_tracer(tracer, include_global_metrics=False)
+        assert rec.resource is None
+        assert "resource" not in rec.to_dict()
+
+    def test_counter_tracks_present_and_clamped(self, tmp_path):
+        rec = _sampled_record()
+        path = str(tmp_path / "trace.json")
+        rec.to_chrome_trace(path)
+        doc = json.load(open(path))
+        events = doc["traceEvents"]
+        counters = [e for e in events if e.get("ph") == "C"]
+        tracks = {e["name"] for e in counters}
+        # >= 2 counter tracks on every platform (device_mb joins when the
+        # backend reports memory stats; XLA:CPU does not)
+        assert {"host_rss_mb", "host_peak_rss_mb"} <= tracks
+        spans_end = max(
+            e["ts"] + e.get("dur", 0) for e in events if e.get("ph") == "X"
+        )
+        for e in counters:
+            assert 0 <= e["ts"] <= spans_end, e
+            assert e["args"]["mb"] >= 0
+
+    def test_peak_track_is_monotone_staircase(self, tmp_path):
+        rec = _sampled_record()
+        from consensusclustr_tpu.obs.export import counter_track_events
+
+        peaks = [
+            e["args"]["mb"]
+            for e in counter_track_events(rec.resource)
+            if e["name"] == "host_peak_rss_mb"
+        ]
+        assert peaks and peaks == sorted(peaks)
+
+    def test_junk_sample_rows_skipped(self):
+        from consensusclustr_tpu.obs.export import counter_track_events
+
+        events = counter_track_events(
+            {"samples": [[0.0, 1e6, None], ["junk"], None, [0.1, "bad", 2]]}
+        )
+        assert len(events) == 2  # only the one valid row, two host tracks
+
+
+# -----------------------------------------------------------------------------
+# pipeline + service integration
+# -----------------------------------------------------------------------------
+
+
+class TestPipelineIntegration:
+    @pytest.mark.smoke
+    def test_consensus_clust_attributes_phases(self, tmp_path):
+        """The acceptance-criteria smoke: a CPU run with the sampler on
+        produces a record whose cocluster/consensus phases carry nonzero
+        rss_peak_bytes and whose trace holds >= 2 counter tracks."""
+        from consensusclustr_tpu.api import consensus_clust
+
+        rng = np.random.default_rng(0)
+        counts = rng.poisson(2.0, size=(90, 60)).astype(np.float32)
+        res = consensus_clust(
+            counts, nboots=2, pc_num=4, seed=1, test_significance=False,
+            resource_sample_ms=5,
+        )
+        rec = res.run_record
+        assert rec.resource is not None and rec.resource["n_samples"] > 0
+        found = {}
+        for root in rec.spans:
+            for _, sp in root.walk():
+                if RSS_PEAK_ATTR in sp.attrs:
+                    found[sp.name] = sp.attrs[RSS_PEAK_ATTR]
+        for phase in ("consensus", "boots", "cocluster"):
+            assert found.get(phase, 0) > 1_000_000, (phase, found)
+        path = str(tmp_path / "t.json")
+        rec.to_chrome_trace(path)
+        tracks = {
+            e["name"]
+            for e in json.load(open(path))["traceEvents"]
+            if e.get("ph") == "C"
+        }
+        assert len(tracks) >= 2
+
+    def test_consensus_cluster_bracket_cleans_up(self):
+        """Direct consensus_cluster callers (no api-level sampler): the
+        pipeline's resource bracket starts AND stops its own sampler."""
+        import jax.numpy as jnp
+
+        from consensusclustr_tpu.config import ClusterConfig
+        from consensusclustr_tpu.consensus.pipeline import consensus_cluster
+        from consensusclustr_tpu.utils.log import LevelLog
+        from consensusclustr_tpu.utils.rng import root_key
+
+        rng = np.random.default_rng(0)
+        pca = rng.normal(size=(64, 5)).astype(np.float32)
+        cfg = ClusterConfig(
+            nboots=2, k_num=(8,), res_range=(0.3, 0.9), max_clusters=16,
+            resource_sample_ms=5,
+        )
+        tracer = Tracer()
+        consensus_cluster(
+            root_key(1), jnp.asarray(pca), cfg, log=LevelLog(tracer=tracer)
+        )
+        sampler = getattr(tracer, "resource_sampler", None)
+        assert sampler is not None and not sampler.running
+        assert sampler.samples
+        boots = next(
+            sp for root in tracer.roots for _, sp in root.walk()
+            if sp.name == "boots"
+        )
+        assert boots.attrs[RSS_PEAK_ATTR] > 1_000_000
+
+    def test_disabled_by_default_no_thread_no_attrs(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_RESOURCE_SAMPLE_MS", raising=False)
+        from consensusclustr_tpu.api import consensus_clust
+
+        rng = np.random.default_rng(0)
+        counts = rng.poisson(2.0, size=(80, 50)).astype(np.float32)
+        res = consensus_clust(
+            counts, nboots=2, pc_num=4, seed=1, test_significance=False
+        )
+        assert res.run_record.resource is None
+        for root in res.run_record.spans:
+            for _, sp in root.walk():
+                assert RSS_PEAK_ATTR not in sp.attrs
+
+
+class TestServiceIntegration:
+    def _artifact(self):
+        from consensusclustr_tpu.serve.artifact import (
+            ReferenceArtifact,
+            level_tables,
+        )
+        from consensusclustr_tpu.serve.assign import embed_reference_counts
+
+        rng = np.random.default_rng(0)
+        n, g, d = 64, 12, 4
+        loadings = np.linalg.qr(rng.normal(size=(g, d)))[0].astype(np.float32)
+        mu = np.zeros(g, np.float32)
+        sigma = np.ones(g, np.float32)
+        counts = rng.poisson(3.0, size=(n, g)).astype(np.float32)
+        libsize_mean = float(counts.sum(1).mean())
+        emb = embed_reference_counts(counts, mu, sigma, loadings, libsize_mean)
+        codes, tables = level_tables(
+            np.asarray([str(i % 3 + 1) for i in range(n)], dtype=object)
+        )
+        return ReferenceArtifact(
+            embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+            libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+            stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+        ), counts
+
+    def test_sampler_survives_drain_and_stops_on_close(self):
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = self._artifact()
+        svc = AssignmentService(
+            art, max_batch=16, buckets=(16,), warmup=True,
+            resource_sample_ms=5,
+        )
+        try:
+            assert svc.resource_sampler.running
+            svc.assign(counts[:4])
+            # peaks visible where /metrics scrapes (the service registry)
+            prom = svc.metrics.to_prom_text()
+            assert "host_rss_bytes" in prom
+            assert "host_peak_rss_bytes" in prom
+        finally:
+            svc.close()
+        assert not svc.resource_sampler.running
+        assert svc.resource_sampler.samples
+        # the drain span got a watermark via the shared tracer hook
+        rec = svc.run_record()
+        assert rec.resource is not None
+
+    def test_service_default_off(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_RESOURCE_SAMPLE_MS", raising=False)
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, _ = self._artifact()
+        with AssignmentService(
+            art, max_batch=16, buckets=(16,), warmup=False
+        ) as svc:
+            assert not svc.resource_sampler.enabled
+            assert not svc.resource_sampler.running
+        assert svc.resource_sampler.samples == []
+
+
+# -----------------------------------------------------------------------------
+# cost-model counters (counting_jit cost_analysis harvest)
+# -----------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_flops_harvested_once_per_bucket(self):
+        import jax.numpy as jnp
+
+        from consensusclustr_tpu.obs import global_metrics
+        from consensusclustr_tpu.utils.compile_cache import counting_jit
+
+        @counting_jit(static_argnames=("k",))
+        def f(x, k):
+            return (x @ x.T).sum() * k
+
+        def snap():
+            c = global_metrics().counters
+            return {
+                name: (c[name].value if name in c else 0.0)
+                for name in (
+                    "estimated_flops", "estimated_bytes_accessed",
+                    "executable_compiles",
+                )
+            }
+
+        before = snap()
+        f(jnp.ones((48, 48)), 2)
+        after_compile = snap()
+        assert after_compile["estimated_flops"] > before["estimated_flops"]
+        assert (
+            after_compile["estimated_bytes_accessed"]
+            > before["estimated_bytes_accessed"]
+        )
+        assert (
+            after_compile["executable_compiles"]
+            == before["executable_compiles"] + 1
+        )
+        f(jnp.ones((48, 48)), 2)  # cache hit: nothing moves
+        assert snap() == after_compile
+
+    def test_harvest_kill_switch(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from consensusclustr_tpu.obs import global_metrics
+        from consensusclustr_tpu.utils.compile_cache import counting_jit
+
+        monkeypatch.setenv("CCTPU_NO_COST_ANALYSIS", "1")
+
+        @counting_jit
+        def g(x):
+            return x * 2.0
+
+        c = global_metrics().counters
+        before = c["estimated_flops"].value if "estimated_flops" in c else 0.0
+        g(jnp.ones((33,)))
+        after = c["estimated_flops"].value if "estimated_flops" in c else 0.0
+        assert after == before
+
+
+# -----------------------------------------------------------------------------
+# tools: report memory table, schema check, bench_diff memory rungs
+# -----------------------------------------------------------------------------
+
+
+class TestReportMemoryTable:
+    def test_renders_phase_watermarks(self):
+        report = _load_tool("report")
+        rec = _sampled_record().to_dict()
+        out = report.memory(rec)
+        assert "boots" in out and "rss MB" in out
+        assert "(run-wide peak)" in out
+        full = report.render(rec)
+        assert "== memory ==" in full
+        assert "WARNING: unknown schema" not in full  # v4 is known
+
+    def test_old_records_render_placeholder(self):
+        report = _load_tool("report")
+        for schema in (1, 2, 3):
+            rec = {"schema": schema, "spans": [], "metrics": {}}
+            out = report.render(rec)
+            assert "(no memory attribution" in out
+            assert "WARNING: unknown schema" not in out
+
+    def test_cli_trace_includes_counter_tracks(self, tmp_path):
+        rec = _sampled_record()
+        path = str(tmp_path / "rr.jsonl")
+        rec.write(path)
+        out_trace = str(tmp_path / "trace.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "report.py"),
+             path, "--trace", out_trace],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        events = json.load(open(out_trace))["traceEvents"]
+        assert sum(1 for e in events if e.get("ph") == "C") >= 2
+
+
+class TestSchemaCheckResourceAttrs:
+    def test_real_sources_clean(self):
+        check_mod = _load_tool("check_obs_schema")
+        assert check_mod.check_resource_attrs(REPO_ROOT) == []
+
+    def test_detects_unregistered_attr(self, tmp_path):
+        check_mod = _load_tool("check_obs_schema")
+        obs_dir = tmp_path / "consensusclustr_tpu" / "obs"
+        obs_dir.mkdir(parents=True)
+        (obs_dir / "resource.py").write_text(
+            'RSS_PEAK_ATTR = "rss_peak_bytes"\n'
+            'ROGUE_ATTR = "never_registered_attr"\n'
+        )
+        errors = check_mod.check_resource_attrs(str(tmp_path))
+        assert any("never_registered_attr" in e for e in errors)
+        # registered-but-unbacked direction
+        assert any("device_peak_bytes" in e for e in errors)
+
+    def test_absent_file_is_clean(self, tmp_path):
+        check_mod = _load_tool("check_obs_schema")
+        assert check_mod.check_resource_attrs(str(tmp_path)) == []
+
+
+def _bench_payload(value=1.0, schema=4, **extra):
+    d = {"metric": "m", "value": value, "unit": "boots/s",
+         "obs_schema": schema, "peak_rss_mb": 500.0, "peak_device_mb": None,
+         "est_flops": 1_000_000}
+    d.update(extra)
+    return d
+
+
+class TestBenchDiffMemoryRungs:
+    def _run(self, tmp_path, old, new, *extra):
+        po, pn = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+        json.dump(old, open(po, "w"))
+        json.dump(new, open(pn, "w"))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             po, pn, *extra],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_rss_gate_catches_a_memory_regression(self, tmp_path):
+        old = _bench_payload()
+        worse = _bench_payload(peak_rss_mb=800.0)  # +60% peak RSS
+        bad = self._run(tmp_path, old, worse, "--gate", "rss:0.9")
+        assert bad.returncode == 3
+        assert "peak_rss_mb" in bad.stderr
+        same = _bench_payload(peak_rss_mb=510.0)
+        ok = self._run(tmp_path, old, same, "--gate", "rss:0.9")
+        assert ok.returncode == 0, ok.stderr
+        assert "peak_rss_mb" in ok.stdout  # rung renders in the delta table
+
+    def test_flops_rung_lower_is_better(self, tmp_path):
+        old = _bench_payload()
+        worse = _bench_payload(est_flops=2_000_000)
+        bad = self._run(tmp_path, old, worse, "--gate", "flops:0.9")
+        assert bad.returncode == 3
+        assert "est_flops" in bad.stderr
+
+    def test_unstamped_old_payload_passes_fence_with_warning(self, tmp_path):
+        """The committed-pair contract: a schema-0 artifact (pre-obs era)
+        paired with a fresh v4 one diffs with a warning, not exit 2 — but
+        two *stamped* payloads straddling a bump still refuse."""
+        old = _bench_payload(schema=None)
+        del old["obs_schema"]
+        proc = self._run(tmp_path, old, _bench_payload())
+        assert proc.returncode == 0, proc.stderr
+        assert "unstamped" in proc.stderr
+        proc = self._run(tmp_path, _bench_payload(schema=3), _bench_payload())
+        assert proc.returncode == 2
+
+    def test_check_mode_on_committed_pair_shows_memory_rungs(self):
+        """BENCH_r06.json (ISSUE 6 satellite) carries the memory rungs; the
+        --check hook over the repo's newest committed pair must pass and its
+        delta table must exercise them."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             "--check", "--dir", REPO_ROOT],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bench_diff: ok" in proc.stdout
+        assert "peak_rss_mb" in proc.stdout
+
+
+class TestBenchResourceKeys:
+    def test_resource_rung_shape(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO_ROOT, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        sampler = bench._start_resource_sampler()
+        assert sampler is not None and sampler.running
+        out = bench._resource_rung(sampler)
+        assert not sampler.running
+        assert out["peak_rss_mb"] > 1.0
+        assert "peak_device_mb" in out
+        # est_flops rides the dispatch delta with the v3 counters
+        assert "est_flops" in bench._DISPATCH_KEYS
+        delta = bench._dispatch_delta(
+            {"est_flops": 5}, {"est_flops": 9, "device_dispatches": 3}
+        )
+        assert delta["est_flops"] == 4
+        # disabled sampler still reports an honest one-shot reading
+        disabled = bench._resource_rung(ResourceSampler(0))
+        assert disabled["peak_rss_mb"] > 1.0
+
+    @pytest.mark.slow
+    def test_bench_ballast_raises_peak_end_to_end(self, tmp_path):
+        """Full-process proof of the acceptance criterion: the same smoke
+        rung with BENCH_BALLAST_MB=256 reports a peak_rss_mb higher by
+        roughly the ballast."""
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", BENCH_CELLS="96", BENCH_BOOTS="2",
+            BENCH_RES="3", BENCH_SERVE_REF="128", BENCH_SERVE_REQUESTS="4",
+        )
+        peaks = {}
+        for mb in ("0", "256"):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+                env=dict(env, BENCH_BALLAST_MB=mb),
+                capture_output=True, text=True, timeout=900,
+            )
+            payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            peaks[mb] = payload["peak_rss_mb"]
+            assert payload["obs_schema"] >= 4
+        assert peaks["256"] - peaks["0"] > 150.0, peaks

@@ -9,6 +9,10 @@ Usage:
     python tools/bench_diff.py OLD NEW --gate compiles:0.99   # program-count
         # gate: "compiles" aliases executable_compiles (lower is better) —
         # fails when NEW compiles more top-level executables than OLD
+    python tools/bench_diff.py OLD NEW --gate rss:0.9         # peak memory
+        # gate: "rss" aliases peak_rss_mb (lower is better) — the O1
+        # peak-memory regression gate; fails when NEW's resource-sampler
+        # peak RSS grew past 1/MIN_FACTOR of OLD's
 
 Inputs are either the driver wrapper shape committed at the repo root
 ({"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {bench line}}) or a raw
@@ -18,10 +22,16 @@ where the driver captured output but did not parse it).
 
 Contracts:
 
-  * **schema fence** — payloads stamped with different ``obs_schema`` versions
-    (missing = 0, the pre-obs era) refuse to diff: phase breakdowns and
-    histogram fields are not comparable across schema bumps. Override with
-    --allow-schema-drift when you know the rungs you gate on are unaffected.
+  * **schema fence** — payloads stamped with DIFFERENT ``obs_schema``
+    versions refuse to diff: phase breakdowns and histogram fields are not
+    comparable across schema bumps. Override with --allow-schema-drift when
+    you know the rungs you gate on are unaffected. A payload with no stamp
+    at all (schema 0 — the pre-obs era, and probe-forced rounds that lost
+    the stamp) passes the fence with a warning instead of refusing: the
+    fence exists to catch *known-incompatible* stamps, and permanently
+    failing CI on every first post-bump round against an unstamped
+    historical artifact would force --allow-schema-drift into the hook,
+    disabling the fence exactly where it matters.
   * **named-rung gates** — ``--gate RUNG:MIN_FACTOR`` computes a regression
     factor per rung (new/old for higher-is-better rungs, old/new for
     lower-is-better like latency; the direction registry is RUNGS below) and
@@ -62,6 +72,13 @@ RUNGS: Dict[str, int] = {
     # here long before boots/s shows it on a noisy CPU round
     "device_dispatches": -1,
     "executable_compiles": -1,
+    # resource profiling (obs schema v4): lower-is-better memory rungs — the
+    # O1 gate surface (peak_device_mb may be null on CPU rounds; a gate on a
+    # null rung fails loudly as "missing", by design) — plus the cost-model
+    # FLOP denominator (fewer estimated flops for the same workload = win)
+    "peak_rss_mb": -1,
+    "peak_device_mb": -1,
+    "est_flops": -1,
     "serving.qps": +1,
     "serving.cells_per_sec": +1,
     "serving.latency_p50_ms": -1,
@@ -74,6 +91,9 @@ RUNGS: Dict[str, int] = {
 RUNG_ALIASES: Dict[str, str] = {
     "compiles": "executable_compiles",
     "dispatches": "device_dispatches",
+    "rss": "peak_rss_mb",
+    "device_mb": "peak_device_mb",
+    "flops": "est_flops",
 }
 
 _JSON_LINE = re.compile(r"^\{.*\}$")
@@ -237,10 +257,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(f"old: {old_path} (obs_schema={s_old}) -- {old.get('metric')}")
     print(f"new: {new_path} (obs_schema={s_new}) -- {new.get('metric')}")
     if s_old != s_new and not args.allow_schema_drift:
-        raise BenchDiffError(
-            2, f"obs_schema drift ({s_old} -> {s_new}): refusing to compare "
-               "(--allow-schema-drift to override)"
-        )
+        if s_old == 0 or s_new == 0:
+            # unstamped side: nothing to fence against — warn, don't refuse
+            # (the docstring's schema-fence contract)
+            print(
+                f"bench_diff: warning: unstamped payload in pair "
+                f"({s_old} -> {s_new}); schema fence skipped",
+                file=sys.stderr,
+            )
+        else:
+            raise BenchDiffError(
+                2, f"obs_schema drift ({s_old} -> {s_new}): refusing to "
+                   "compare (--allow-schema-drift to override)"
+            )
     print(diff_table(old, new))
 
     failures = []

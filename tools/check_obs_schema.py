@@ -22,6 +22,13 @@ Since ISSUE 4 the registry also carries per-metric help text
 when METRIC_HELP and METRIC_NAMES drift apart, so every exported series is
 documented and no documented series is unregistered.
 
+Since ISSUE 6 the check also walks ``obs/resource.py``'s span-attr literals
+(the ``RSS_PEAK_ATTR = "rss_peak_bytes"``-style module constants the
+ResourceSampler stamps on closing spans) against
+``obs.schema.RESOURCE_SPAN_ATTRS``, both directions — a renamed watermark
+attr is a test failure, not a silently empty "== memory ==" table in
+tools/report.py.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -48,6 +55,8 @@ MAYBE_SPAN_RE = re.compile(
 METRIC_RE = re.compile(
     r"""\.(counter|gauge|histogram)\(\s*["']([A-Za-z0-9_]+)["']"""
 )
+# obs/resource.py span-attr constants: NAME_ATTR = "literal" at module level
+ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""")
 
 # Scanned trees/files, relative to the repo root. Tests are exempt (they
 # exercise the machinery with throwaway names on purpose). The package walk
@@ -95,9 +104,42 @@ def check_help_registry() -> List[str]:
     return errors
 
 
+def check_resource_attrs(root: str) -> List[str]:
+    """obs/resource.py ``*_ATTR`` literals <-> schema.RESOURCE_SPAN_ATTRS,
+    both directions: every literal registered, every registered attr backed
+    by a literal. Roots without an obs/resource.py (the synthetic trees the
+    tests build) have nothing to validate and pass clean."""
+    rel = os.path.join("consensusclustr_tpu", "obs", "resource.py")
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return []
+    registry = getattr(schema, "RESOURCE_SPAN_ATTRS", None)
+    if registry is None:
+        return ["obs/schema.py: RESOURCE_SPAN_ATTRS registry is missing"]
+    errors: List[str] = []
+    found = {}
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = ATTR_RE.match(line)
+            if m:
+                found[m.group(2)] = (m.group(1), lineno)
+    for name, (const, lineno) in sorted(found.items()):
+        if name not in registry:
+            errors.append(
+                f"{rel}:{lineno}: span attr {name!r} ({const}) not in "
+                "obs.schema.RESOURCE_SPAN_ATTRS"
+            )
+    for name in sorted(set(registry) - set(found)):
+        errors.append(
+            f"obs/schema.py: RESOURCE_SPAN_ATTRS entry {name!r} has no "
+            f"*_ATTR literal in {rel}"
+        )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
-    errors: List[str] = check_help_registry()
+    errors: List[str] = check_help_registry() + check_resource_attrs(root)
     for path in _py_files(root):
         rel = os.path.relpath(path, root)
         with open(path, encoding="utf-8") as f:

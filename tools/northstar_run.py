@@ -8,8 +8,15 @@ flight — rerunning continues from disk. Run it as many times as it takes;
 when the boots are all banked the consensus tail + merges + gate complete the
 pipeline and the summary JSON prints.
 
+Memory accounting rides the obs/resource.py ResourceSampler (ISSUE 6): one
+sampler brackets the whole run (fixture generation included) at NS_SAMPLE_MS
+(default 200 ms), and the same interval is passed into ``consensus_clust`` so
+the run record's per-phase ``rss_peak_bytes`` attrs, the Perfetto counter
+tracks, and the ``peak_rss_gb`` printed here all come from the one mechanism
+— no more ad-hoc ``getrusage`` numbers that the obs layer can't see.
+
 Env knobs: NS_CELLS (50000), NS_BOOTS (1000), NS_RES (12), NS_GENES (2000),
-NS_CKPT (./northstar_ckpt), NS_MODE (robust).
+NS_CKPT (./northstar_ckpt), NS_MODE (robust), NS_SAMPLE_MS (200).
 
 Usage: python tools/northstar_run.py
 """
@@ -18,11 +25,15 @@ from __future__ import annotations
 
 import json
 import os
-import resource
 import sys
 import time
 
 import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def main() -> int:
@@ -31,12 +42,20 @@ def main() -> int:
     from consensusclustr_tpu.api import consensus_clust
     from consensusclustr_tpu.utils.synth import nb_mixture_counts
 
+    from consensusclustr_tpu.obs.resource import ResourceSampler
+
     n = int(os.environ.get("NS_CELLS", 50_000))
     nboots = int(os.environ.get("NS_BOOTS", 1000))
     n_res = int(os.environ.get("NS_RES", 12))
     n_genes = int(os.environ.get("NS_GENES", 2000))
     ckpt = os.environ.get("NS_CKPT", os.path.abspath("northstar_ckpt"))
     mode = os.environ.get("NS_MODE", "robust")
+    sample_ms = int(os.environ.get("NS_SAMPLE_MS", 200))
+    # one sampler for the whole process: fixture generation + the run; the
+    # pipeline-internal sampler (resource_sample_ms below) shares the same
+    # mechanism, so the summary's peak and the run record's per-phase
+    # watermarks are the same numbers
+    sampler = ResourceSampler(sample_ms).start()
     # env-first: a JAX_PLATFORMS=cpu run must not dial a wedged tunnel
     # (and must re-pin jax's config past the sitecustomize override)
     from consensusclustr_tpu.utils.backend import default_backend
@@ -71,13 +90,15 @@ def main() -> int:
         progress=True,
         seed=1,
         test_significance=significance,
+        resource_sample_ms=sample_ms,
     )
     wall = time.time() - t0
 
     from sklearn.metrics import adjusted_rand_score
 
     ari = adjusted_rand_score(truth, res.assignments.astype(str))
-    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    sampler.stop()
+    peak_rss_gb = sampler.peak_rss_bytes / 1e9
     out = {
         "north_star": f"{n} cells x {nboots} boots x {n_res} res, {mode}",
         "backend": backend,

@@ -10,8 +10,11 @@ Usage:
         #   open out.json in ui.perfetto.dev (docs/perf.md "Exporting a trace")
 
 Produces: a per-phase table (top-level spans, seconds, % of wall), a
-flamegraph-style text rendering of the span tree, error events, and the
-metrics snapshot (bucketed histograms render p50/p99 estimates).
+flamegraph-style text rendering of the span tree, a "== memory ==" table
+(per-phase peak RSS/device watermarks when the run sampled resources —
+obs schema >= 4), error events, and the metrics snapshot (bucketed
+histograms render p50/p99 estimates). --trace additionally renders the
+resource series as Perfetto counter tracks under the span lanes.
 
 Deliberately standalone — parses the schema-versioned JSON directly, no
 package (or jax) import, so it runs anywhere a record file lands (including
@@ -28,7 +31,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3)
+KNOWN_SCHEMAS = (1, 2, 3, 4)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -234,6 +237,49 @@ def dispatch(record: dict) -> str:
     return "\n".join(lines)
 
 
+def memory(record: dict) -> str:
+    """Per-phase peak-memory attribution table (obs schema >= 4): spans
+    stamped with ``rss_peak_bytes`` (and, when the backend reports memory,
+    ``device_peak_bytes``) by the obs/resource.py sampler's span-close hook,
+    plus the run-wide watermark from the record's ``resource`` block. Records
+    written with sampling off (the default) or by older schemas render the
+    placeholder line — absence is normal, never an error (same guard style
+    as the serving and dispatch tables)."""
+    lines: List[str] = []
+
+    def walk(span: dict, depth: int) -> None:
+        attrs = span.get("attrs") or {}
+        rss = attrs.get("rss_peak_bytes")
+        dev = attrs.get("device_peak_bytes")
+        if rss is not None or dev is not None:
+            label = "  " * depth + span.get("name", "?")
+            rss_s = f"{rss / 1e6:>10.1f}" if rss is not None else f"{'-':>10}"
+            dev_s = f"{dev / 1e6:>12.1f}" if dev is not None else f"{'-':>12}"
+            lines.append(f"{label:<34} {rss_s} {dev_s}")
+        for child in span.get("children", []):
+            walk(child, depth + 1)
+
+    for s in record.get("spans", []):
+        walk(s, 0)
+    res = record.get("resource") or {}
+    if not lines and not res:
+        return "(no memory attribution — resource sampling off)"
+    out = [f"{'phase':<34} {'rss MB':>10} {'device MB':>12}"]
+    out.extend(lines if lines else ["(no span watermarks)"])
+    peak = res.get("rss_peak_bytes")
+    if peak is not None:
+        dev_peak = res.get("device_peak_bytes")
+        dev_s = (
+            f"{dev_peak / 1e6:>12.1f}" if dev_peak is not None else f"{'-':>12}"
+        )
+        out.append(f"{'(run-wide peak)':<34} {peak / 1e6:>10.1f} {dev_s}")
+    if res.get("n_samples") is not None:
+        out.append(
+            f"samples: {res.get('n_samples')} at {res.get('sample_ms')} ms"
+        )
+    return "\n".join(out)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -270,6 +316,7 @@ def render(record: dict) -> str:
         "", "== pipelining ==", pipelining(record),
         "", "== serving ==", serving(record),
         "", "== dispatch ==", dispatch(record),
+        "", "== memory ==", memory(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]
@@ -310,6 +357,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "config_fingerprint": rec.get("config_fingerprint"),
                 "wall_s": rec.get("wall_s"),
             },
+            resource=rec.get("resource"),
         )
         out.append(f"trace -> {args.trace} (open in ui.perfetto.dev)")
     print("\n".join(out))

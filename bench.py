@@ -168,6 +168,87 @@ _SERVING_ZERO = {
     "bucket_compiles": 0,
 }
 
+# The serving-SLO rung's zero shape (ISSUE 7): the ladder block plus the two
+# top-level gate rungs tools/bench_diff.py reads (--gate p99:... /
+# serve_rejection_rate). Emitted on every rung including failure.
+_SERVING_SLO_ZERO = {
+    "serving_slo": {"steps": []},
+    "serving_p99_ms": 0.0,
+    "serve_rejection_rate": 0.0,
+}
+
+
+def _load_loadgen():
+    """tools/loadgen.py by file path (same pattern as tools/report.py's
+    export loader — bench.py must not depend on tools/ being a package)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "loadgen.py")
+    spec = importlib.util.spec_from_file_location("_cctpu_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _serving_slo_rung() -> dict:
+    """Open-loop serving-SLO ladder (ISSUE 7 tentpole): tools/loadgen.py
+    drives a live AssignmentService at >= 3 offered rates scaled off a
+    closed-loop capacity probe (0.5x / 1x / 2x, so "saturated" means the
+    same thing on every backend), each step reporting goodput, rejection
+    rate and client-side p50/p99/p999. The gate surface is the SATURATION
+    step (highest offered rate): ``serving_p99_ms`` and
+    ``serve_rejection_rate`` land top-level so ``bench_diff --gate p99:...``
+    can gate tail-latency regressions the way it gates boots/s, compiles and
+    RSS. Env knobs: BENCH_SLO_RATES (comma list overrides the capacity
+    scaling), BENCH_SLO_DURATION (seconds/step, default 1.5),
+    BENCH_SLO_SIZES. Never raises: any failure returns the zero shape with
+    an error note.
+    """
+    try:
+        lg = _load_loadgen()
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        genes = int(os.environ.get("BENCH_SERVE_GENES", 256))
+        n_ref = int(os.environ.get("BENCH_SERVE_REF", 2048))
+        duration = float(os.environ.get("BENCH_SLO_DURATION", 1.5))
+        mix = lg.parse_sizes(os.environ.get("BENCH_SLO_SIZES", "1:0.5,4:0.3,16:0.2"))
+        art, _ = lg.synthetic_artifact(n_ref, genes, seed=0)
+
+        rates_env = os.environ.get("BENCH_SLO_RATES", "").strip()
+        if rates_env:
+            rates = [float(r) for r in rates_env.split(",") if r.strip()]
+        else:
+            with AssignmentService(
+                art, max_batch=64, queue_depth=16
+            ) as probe_svc:
+                cap = lg.estimate_capacity(probe_svc, mix, genes, n_requests=24)
+            rates = [
+                round(cap * f, 2) for f in (0.5, 1.0, 2.0)
+            ]
+        ladder = lg.slo_ladder(
+            art, rates, duration, genes, mix, seed=7,
+            queue_depth=16, max_batch=64,
+        )
+        # gate surface: the saturation (highest offered rate) step — the
+        # number an SLO actually binds ("p99 under target AT saturation")
+        sat = max(
+            (s for s in ladder["steps"] if "error" not in s),
+            key=lambda s: s.get("offered_rps", 0.0),
+            default=None,
+        )
+        out = {"serving_slo": ladder}
+        out["serving_p99_ms"] = float(sat["p99_ms"] or 0.0) if sat else 0.0
+        out["serve_rejection_rate"] = (
+            float(sat["rejection_rate"]) if sat else 0.0
+        )
+        return out
+    except Exception as e:
+        out = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _SERVING_SLO_ZERO.items()}
+        out["serving_slo"]["error"] = str(e)[:200]
+        return out
+
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
@@ -340,6 +421,7 @@ def _run_pbmc3k() -> dict:
             res.run_record.spans if res.run_record is not None else []
         ),
         "serving": _serving_rung(),
+        **_serving_slo_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -404,6 +486,7 @@ def _run_granular() -> dict:
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
         "serving": _serving_rung(),
+        **_serving_slo_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -530,6 +613,7 @@ def _run() -> dict:
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
         "serving": _serving_rung(),
+        **_serving_slo_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -727,6 +811,8 @@ def main() -> None:
             "pipeline_depth": _pipeline_depth(),
             "overlap_ratio": 0.0,
             "serving": dict(_SERVING_ZERO),
+            **{k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _SERVING_SLO_ZERO.items()},
             "probe_s": probe_s,
             **_dispatch_delta(dispatch0, _dispatch_counters()),
             **_resource_rung(sampler),

@@ -103,6 +103,10 @@ def counter_track_events(
     return out
 
 
+FLOW_EVENT_NAME = "serve_request"
+FLOW_LANE_NAME = "serve_requests"
+
+
 def chrome_trace_events(
     spans: Iterable[Any],
     events: Iterable[dict] = (),
@@ -119,6 +123,17 @@ def chrome_trace_events(
     contract (events on one tid must nest) is stricter than the tree's.
     A ``resource`` block appends :func:`counter_track_events` clamped to the
     span lanes' end.
+
+    Request flow links (ISSUE 7): a span carrying a ``request_ids`` attr (the
+    AssignmentService ``serve_batch`` spans) anchors each listed id; every
+    ``serve_request`` event whose ``req_id`` is anchored renders as (a) a
+    residency slice on a dedicated ``serve_requests`` lane from its submit
+    instant to its batch's start — the queue+batch-formation wait made
+    visible — and (b) a Perfetto flow pair (``ph:"s"`` at the submit instant,
+    ``ph:"f"``/``bp:"e"`` at the batch span) with ``id`` = the request id, so
+    ui.perfetto.dev draws an arrow from each request to the batch that served
+    it. Unanchored events (request still in flight, or records past the
+    service's lifecycle cap) keep their plain instants and link nothing.
     """
     out: List[dict] = [
         {
@@ -127,6 +142,8 @@ def chrome_trace_events(
         },
     ]
     lanes: Dict[str, int] = {}
+    # request id -> (batch-span start us, batch-span tid): flow-finish anchors
+    anchors: Dict[int, tuple] = {}
 
     def lane_for(root_name: str) -> int:
         if root_name not in lanes:
@@ -158,6 +175,11 @@ def chrome_trace_events(
         if args:
             ev["args"] = args
         out.append(ev)
+        for rid in args.get("request_ids") or ():
+            try:
+                anchors.setdefault(int(rid), (ts, tid))
+            except (TypeError, ValueError):
+                pass
         for child in span.get("children", []):
             emit(_span_dict(child), tid, ts, ts + dur)
 
@@ -180,6 +202,27 @@ def chrome_trace_events(
         if args:
             rec["args"] = args
         out.append(rec)
+        if rec["name"] == FLOW_EVENT_NAME and "req_id" in args:
+            try:
+                rid = int(args["req_id"])
+            except (TypeError, ValueError):
+                continue
+            if rid not in anchors:
+                continue
+            a_ts, a_tid = anchors[rid]
+            ts = rec["ts"]
+            a_ts = max(a_ts, ts)  # independent rounding can reorder by <1 tick
+            req_tid = lane_for(FLOW_LANE_NAME)
+            base = {"name": FLOW_EVENT_NAME, "cat": "serve", "pid": TRACE_PID}
+            out.append({  # residency slice: submit -> serving batch start
+                **base, "ph": "X", "ts": ts, "dur": max(a_ts - ts, 1),
+                "tid": req_tid, "args": {"req_id": rid},
+            })
+            out.append({**base, "ph": "s", "id": rid, "ts": ts, "tid": req_tid})
+            out.append({
+                **base, "ph": "f", "bp": "e", "id": rid, "ts": a_ts,
+                "tid": a_tid,
+            })
     if resource:
         ends = [
             e["ts"] + e.get("dur", 0) for e in out if e.get("ph") in ("X", "i")

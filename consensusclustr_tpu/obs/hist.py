@@ -61,6 +61,27 @@ def bucket_index(bounds: Sequence[float], value: float) -> int:
     return bisect_left(bounds, value)
 
 
+def merge_bucket_counts(
+    bounds_a: Sequence[float],
+    counts_a: Sequence[int],
+    bounds_b: Sequence[float],
+    counts_b: Sequence[int],
+) -> Optional[List[int]]:
+    """Elementwise sum of two bucket-count vectors when their ``le`` ladders
+    match exactly; None on a mismatch (the caller keeps the exact streaming
+    summary but loses quantiles — count that drop, don't hide it: the
+    ``hist_merge_mismatch`` counter and metrics.py's one-time warning exist
+    because the PR 4 behavior was a silent drop)."""
+    if (
+        not counts_a
+        or not counts_b
+        or tuple(bounds_a) != tuple(bounds_b)
+        or len(counts_a) != len(counts_b)
+    ):
+        return None
+    return [int(a) + int(b) for a, b in zip(counts_a, counts_b)]
+
+
 def bucket_quantile(
     bounds: Sequence[float],
     counts: Sequence[int],

@@ -31,7 +31,30 @@ from consensusclustr_tpu.obs.hist import (
     DEFAULT_BOUNDS,
     bucket_index,
     bucket_quantile,
+    merge_bucket_counts,
 )
+
+# One warning per process for bucket-ladder merge drops (ISSUE 7 satellite):
+# the drop itself is counted per occurrence (``hist_merge_mismatch``), the
+# log line fires once so a merge-heavy run cannot flood stderr.
+_MERGE_MISMATCH_WARNED = False
+
+
+def _warn_merge_mismatch(name: str) -> None:
+    global _MERGE_MISMATCH_WARNED
+    if _MERGE_MISMATCH_WARNED:
+        return
+    _MERGE_MISMATCH_WARNED = True
+    try:
+        from consensusclustr_tpu.utils.log import get_logger
+
+        get_logger().warning(
+            "histogram %r merged across mismatched bucket ladders: bucket "
+            "counts dropped (summary stays exact, quantiles return None); "
+            "counted in hist_merge_mismatch, warning once per process", name
+        )
+    except Exception:
+        pass  # observability must never fail the merge
 
 
 @dataclasses.dataclass
@@ -133,9 +156,12 @@ class MetricsRegistry:
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold ``other`` into self: counters add, later gauges win (when
-        set), histogram summaries and bucket counts combine (buckets are
-        dropped on a bounds mismatch — the summary stays exact, quantiles
-        return None). Returns self for chaining."""
+        set), histogram summaries and bucket counts combine. An empty
+        receiver adopts the incoming bucket ladder; a genuine bounds
+        mismatch drops the buckets (the summary stays exact, quantiles
+        return None) — counted in ``hist_merge_mismatch`` and warned once
+        per process (ISSUE 7 satellite: the PR 4 drop was silent). Returns
+        self for chaining."""
         with self._lock:
             for name, c in other.counters.items():
                 self.counters.setdefault(name, Counter()).inc(c.value)
@@ -144,6 +170,7 @@ class MetricsRegistry:
                     self.gauges.setdefault(name, Gauge()).set(g.value)
             for name, h in other.histograms.items():
                 mine = self.histograms.setdefault(name, Histogram())
+                fresh = mine.count == 0  # nothing observed: adopt their ladder
                 mine.count += h.count
                 mine.sum += h.sum
                 for bound in ("min", "max"):
@@ -155,16 +182,23 @@ class MetricsRegistry:
                         min(ours, theirs) if bound == "min" else max(ours, theirs)
                     )
                     setattr(mine, bound, pick)
-                if (
-                    mine.bucket_counts
-                    and h.bucket_counts
-                    and tuple(mine.bounds) == tuple(h.bounds)
-                ):
-                    mine.bucket_counts = [
-                        a + b for a, b in zip(mine.bucket_counts, h.bucket_counts)
-                    ]
+                if fresh and h.bucket_counts:
+                    mine.bounds = tuple(h.bounds)
+                    mine.bucket_counts = list(h.bucket_counts)
+                    continue
+                merged = merge_bucket_counts(
+                    mine.bounds, mine.bucket_counts, h.bounds, h.bucket_counts
+                )
+                if merged is not None:
+                    mine.bucket_counts = merged
                 else:
                     mine.bucket_counts = []
+                    # direct dict access: self._lock is held (non-reentrant),
+                    # the counter() accessor would deadlock here
+                    self.counters.setdefault(
+                        "hist_merge_mismatch", Counter()
+                    ).inc()
+                    _warn_merge_mismatch(name)
         return self
 
     def snapshot(self) -> dict:

@@ -33,7 +33,18 @@ from __future__ import annotations
 # and counting_jit harvests XLA cost_analysis into the
 # ``estimated_flops``/``estimated_bytes_accessed`` counters. See
 # docs/quirks.md "Observability schema v3 → v4".
-SCHEMA_VERSION = 4
+# v5 (ISSUE 7): request-lifecycle tracing — every AssignmentService request
+# carries a monotonically issued id plus enqueue/dequeue/dispatch/complete
+# timestamps; submit→result latency decomposes into the
+# ``queue_wait_seconds`` / ``batch_wait_seconds`` / ``device_seconds``
+# histograms (their per-request sum equals ``serve_latency_seconds`` by
+# construction), each micro-batch closes a ``serve_batch`` span carrying its
+# request-id list + queue-age-at-dispatch attrs, each accepted submit emits a
+# ``serve_request`` instant event, and obs/export.py links the two with
+# Perfetto flow events (``ph:"s"``/``ph:"f"``). ``hist_merge_mismatch``
+# counts histogram bucket ladders dropped on merge (previously silent). See
+# docs/quirks.md "Observability schema v4 → v5".
+SCHEMA_VERSION = 5
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -70,6 +81,8 @@ EVENT_KINDS = frozenset({
     "serve_start",
     "serve_drain",
     "serve_metrics",   # /metrics + /healthz HTTP exporter came up (port attr)
+    "serve_request",   # one accepted submit (req_id + rows attrs) — the
+                       # request's flow-event anchor in the Perfetto export
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -97,6 +110,8 @@ SPAN_NAMES = frozenset({
     "null_sim_chunk",
     # serve/service.py
     "serve_warmup",     # bucket-ladder compile pass at service load
+    "serve_batch",      # one micro-batch: request_ids list, bucket, rows,
+                        # queue-age-at-dispatch attrs (the flow-event target)
 })
 
 # Metric name -> one-line help text. This IS the metric registry: the name
@@ -120,6 +135,11 @@ METRIC_HELP = {
     "phase_seconds": "histogram: wall seconds per closed top-level pipeline phase span",
     # serve/ — the online assignment subsystem
     "serve_latency_seconds": "histogram: submit -> result per request",
+    # request-lifecycle decomposition (ISSUE 7): per request, these three sum
+    # to serve_latency_seconds by construction (same clock reads)
+    "queue_wait_seconds": "histogram: submit -> worker dequeue per request (time spent in the bounded queue)",
+    "batch_wait_seconds": "histogram: worker dequeue -> batch dispatch per request (batch-formation wait)",
+    "device_seconds": "histogram: batch dispatch -> results on host, per request (device + transfer share)",
     "queue_depth": "gauge: request-queue occupancy at last submit/dequeue",
     "batch_occupancy": "gauge: rows/bucket fill of the last micro-batch",
     "serve_compile": "counter: bucket-shape first dispatches (XLA compiles)",
@@ -136,6 +156,9 @@ METRIC_HELP = {
     # cost-model accounting (utils/compile_cache.counting_jit, ISSUE 6)
     "estimated_flops": "counter: summed one-execution XLA cost_analysis flops of compiled entry programs",
     "estimated_bytes_accessed": "counter: summed one-execution XLA cost_analysis bytes accessed of compiled entry programs",
+    # registry self-observability (ISSUE 7 satellite): merge drops bucket
+    # ladders on a bounds mismatch — previously silent, now counted
+    "hist_merge_mismatch": "counter: histogram merges that dropped bucket counts on a bounds-ladder mismatch",
 }
 
 # Metrics registry names (counters, gauges, histograms).

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -73,9 +74,16 @@ class Span:
 
 class Tracer:
     """Collects a span tree, a flat event list, and a metrics registry for
-    one run. Not thread-safe by design: the pipeline's host control is a
-    single thread (SURVEY §7.1), and a lock in the hot path would cost more
-    than it protects."""
+    one run.
+
+    Thread model (ISSUE 7): the open-span stack is **thread-local** — each
+    thread nests its own spans and sees only its own span path, so the
+    serving worker's per-batch spans can never splice into (or pop) a span
+    another thread holds open, and an event emitted from a client thread is
+    never stamped with some other thread's span path. The shared collections
+    (``roots``, ``events``) take only GIL-atomic appends; there is still no
+    lock in the hot path (SURVEY §7.1 — the pipeline's host control is one
+    thread, and serving adds exactly one span-writing worker)."""
 
     def __init__(
         self,
@@ -89,10 +97,18 @@ class Tracer:
         self.roots: List[Span] = []
         self.events: List[dict] = []
         self.epoch = time.monotonic()
-        self._stack: List[Span] = []
+        self._local = threading.local()
         # span-close hooks (obs/resource.py watermark attribution): called
         # with the closed Span after ``seconds`` is set; exceptions swallowed
         self._span_close_hooks: List[Any] = []
+
+    @property
+    def _stack(self) -> List[Span]:
+        """This thread's open-span stack (created on first touch)."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     def add_span_close_hook(self, fn: Any) -> None:
         """Register ``fn(span)`` to run whenever a span closes (after its

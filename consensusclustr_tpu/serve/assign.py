@@ -157,6 +157,12 @@ class AssignResult:
     neighbor_stability: np.ndarray    # [q] float32 mean winning-neighbour stability
     nearest_distance: np.ndarray      # [q] float32 distance to nearest ref cell
     levels: Optional[Dict[int, np.ndarray]] = None  # granular mode only
+    # Request-lifecycle decomposition (ISSUE 7), filled only by the
+    # AssignmentService path: req_id plus queue_wait_s / batch_wait_s /
+    # device_s / latency_s (the first three sum to latency_s by construction
+    # — same clock reads) and the batch context (bucket, batch_rows,
+    # batch_requests). None on direct assign_cells calls.
+    timing: Optional[Dict[str, float]] = None
 
 
 class CompileTracker:

@@ -28,6 +28,29 @@ Observability (names registered in obs/schema.py):
   * ``serve_compile`` counter — bucket-shape first dispatches (compiles);
   * ``serve_rejections`` counter — backpressure rejections.
 
+Request lifecycle (ISSUE 7 tentpole): every accepted request carries a
+monotonically issued ``req_id`` and four timestamps — submit (enqueue),
+worker dequeue, batch dispatch, batch complete — decomposed into three
+histograms whose per-request sum equals ``serve_latency_seconds`` exactly
+(same clock reads, no independent measurement):
+
+  * ``queue_wait_seconds``  — submit → dequeue (time in the bounded queue);
+  * ``batch_wait_seconds``  — dequeue → dispatch (batch-formation wait,
+    including host-side concatenation);
+  * ``device_seconds``      — dispatch → results on host (device + transfer;
+    one value per batch, observed once per request so counts line up).
+
+Each micro-batch additionally closes a ``serve_batch`` span (worker thread;
+the tracer's span stacks are thread-local) carrying the batch's request-id
+list, bucket, rows and queue-age-at-dispatch attrs, and each accepted submit
+emits a ``serve_request`` instant event — obs/export.py turns the pair into
+Perfetto flow events so a request's submit instant visually links to the
+batch span that served it. Per-request records stop after
+``LIFECYCLE_RECORD_CAP`` requests (histograms and counters continue
+unbounded — only the trace-visualization stream is capped, docs/quirks.md).
+The same decomposition rides each result as ``AssignResult.timing`` so
+clients (tools/loadgen.py) can parity-check the sum without scraping.
+
 Scrape endpoint (ISSUE 4): when ``serve_metrics_port`` /
 ``CCTPU_SERVE_METRICS_PORT`` names a port (0 = ephemeral; default OFF), a
 stdlib ``http.server`` daemon thread serves ``/metrics`` (Prometheus text via
@@ -53,6 +76,7 @@ Defaults are documented in docs/quirks.md.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
@@ -78,6 +102,11 @@ from consensusclustr_tpu.serve.assign import (
 )
 
 DEFAULT_QUEUE_DEPTH = 64
+
+# Per-request trace records (serve_request events + serve_batch spans) stop
+# after this many requests so a long-lived service's tracer stays bounded;
+# the lifecycle histograms and counters keep going forever (docs/quirks.md).
+LIFECYCLE_RECORD_CAP = 100_000
 
 _SENTINEL = None
 
@@ -180,13 +209,18 @@ class _MetricsHTTPServer:
 
 
 class _Request:
-    __slots__ = ("counts_hvg", "mode", "future", "t_submit", "rows")
+    __slots__ = (
+        "counts_hvg", "mode", "future", "req_id",
+        "t_submit", "t_dequeue", "rows",
+    )
 
-    def __init__(self, counts_hvg: np.ndarray, mode: str) -> None:
+    def __init__(self, counts_hvg: np.ndarray, mode: str, req_id: int) -> None:
         self.counts_hvg = counts_hvg
         self.mode = mode
         self.future: Future = Future()
-        self.t_submit = time.perf_counter()
+        self.req_id = req_id
+        self.t_submit = time.perf_counter()   # enqueue instant
+        self.t_dequeue: Optional[float] = None  # worker pop (queue_wait end)
         self.rows = int(counts_hvg.shape[0])
 
 
@@ -273,6 +307,9 @@ class AssignmentService:
             self.resource_sampler.attach(self.tracer)
         self._accepted = 0
         self._completed = 0
+        # monotonically issued request ids (next() is GIL-atomic; submits may
+        # come from any thread)
+        self._req_ids = itertools.count(1)
         if warmup:
             self.warmup()
         if start:
@@ -386,7 +423,7 @@ class AssignmentService:
                 f"request of {counts_hvg.shape[0]} rows exceeds "
                 f"serve_max_batch={self.max_batch}; split it client-side"
             )
-        req = _Request(counts_hvg, mode)
+        req = _Request(counts_hvg, mode, next(self._req_ids))
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -396,6 +433,10 @@ class AssignmentService:
             ) from None
         self._accepted += 1
         self.metrics.gauge("queue_depth").set(self._queue.qsize())
+        if req.req_id <= LIFECYCLE_RECORD_CAP:
+            # the request's flow-event anchor: obs/export.py links this
+            # instant to the serve_batch span that carries req_id
+            self.tracer.event("serve_request", req_id=req.req_id, rows=req.rows)
         return req.future
 
     def assign(self, counts, mode: Optional[str] = None, timeout=None) -> AssignResult:
@@ -416,6 +457,7 @@ class AssignmentService:
                 item = self._queue.get()
                 if item is _SENTINEL:
                     return
+                item.t_dequeue = time.perf_counter()  # queue_wait ends here
                 pending.append(item)
             # opportunistic non-blocking drain: batch whatever has piled up
             while not drained:
@@ -426,6 +468,7 @@ class AssignmentService:
                 if item is _SENTINEL:
                     drained = True
                     break
+                item.t_dequeue = time.perf_counter()
                 pending.append(item)
             self.metrics.gauge("queue_depth").set(self._queue.qsize())
             batch, rows = [], 0
@@ -435,45 +478,96 @@ class AssignmentService:
                 rows += req.rows
             self._run_batch(batch, rows)
 
+    def _batch_span(self, batch, rows: int):
+        """serve_batch span for this micro-batch — or an inert detached span
+        once LIFECYCLE_RECORD_CAP batches of records have accumulated, so a
+        long-lived service's tracer stays bounded (histograms continue)."""
+        from consensusclustr_tpu.obs.tracer import _null_span
+
+        attrs = dict(
+            request_ids=[r.req_id for r in batch],
+            n_requests=len(batch),
+            rows=rows,
+        )
+        if batch[0].req_id > LIFECYCLE_RECORD_CAP:
+            return _null_span("serve_batch", **attrs)
+        return self.tracer.span("serve_batch", **attrs)
+
     def _run_batch(self, batch, rows: int) -> None:
-        try:
-            bucket = bucket_for(rows, self.buckets)
-            self.metrics.gauge("batch_occupancy").set(rows / bucket)
-            counts = (
-                batch[0].counts_hvg
-                if len(batch) == 1
-                else np.concatenate([r.counts_hvg for r in batch], axis=0)
-            )
-            codes, frac, stab, dist = assign_bucketed(
-                self.reference, counts, k=self.k, buckets=self.buckets,
-                snap_eps=self.snap_eps, metrics=self.metrics,
-                compile_tracker=self._tracker,
-            )
-            t_done = time.perf_counter()
-            s = 0
-            for req in batch:
-                e = s + req.rows
-                labels, levels = _labels_from_codes(
-                    self.reference, codes[s:e], req.mode == "granular"
+        with self._batch_span(batch, rows) as sp:
+            try:
+                bucket = bucket_for(rows, self.buckets)
+                self.metrics.gauge("batch_occupancy").set(rows / bucket)
+                counts = (
+                    batch[0].counts_hvg
+                    if len(batch) == 1
+                    else np.concatenate([r.counts_hvg for r in batch], axis=0)
                 )
-                result = AssignResult(
-                    labels=labels,
-                    confidence=frac[s:e],
-                    neighbor_stability=stab[s:e],
-                    nearest_distance=dist[s:e],
-                    levels=levels,
+                # batch formation (incl. the concat above) ends, device
+                # work begins: the batch_wait / device_seconds boundary
+                t_dispatch = time.perf_counter()
+                ages = [t_dispatch - r.t_submit for r in batch]
+                sp.set(
+                    bucket=bucket,
+                    queue_age_max_s=round(max(ages), 6),
+                    queue_age_mean_s=round(sum(ages) / len(ages), 6),
                 )
-                self.metrics.histogram("serve_latency_seconds").observe(
-                    t_done - req.t_submit
+                codes, frac, stab, dist = assign_bucketed(
+                    self.reference, counts, k=self.k, buckets=self.buckets,
+                    snap_eps=self.snap_eps, metrics=self.metrics,
+                    compile_tracker=self._tracker,
                 )
-                req.future.set_result(result)
-                self._completed += 1
-                s = e
-        except BaseException as e:  # fail the whole batch, keep serving
-            for req in batch:
-                if not req.future.done():
-                    req.future.set_exception(e)
+                t_done = time.perf_counter()
+                device_s = t_done - t_dispatch
+                s = 0
+                for req in batch:
+                    e = s + req.rows
+                    labels, levels = _labels_from_codes(
+                        self.reference, codes[s:e], req.mode == "granular"
+                    )
+                    # the decomposition: three disjoint intervals over the
+                    # same clock, so their sum IS the end-to-end latency
+                    t_deq = req.t_dequeue if req.t_dequeue is not None \
+                        else req.t_submit
+                    queue_wait = t_deq - req.t_submit
+                    batch_wait = t_dispatch - t_deq
+                    latency = t_done - req.t_submit
+                    result = AssignResult(
+                        labels=labels,
+                        confidence=frac[s:e],
+                        neighbor_stability=stab[s:e],
+                        nearest_distance=dist[s:e],
+                        levels=levels,
+                        timing={
+                            "req_id": req.req_id,
+                            "queue_wait_s": queue_wait,
+                            "batch_wait_s": batch_wait,
+                            "device_s": device_s,
+                            "latency_s": latency,
+                            "bucket": bucket,
+                            "batch_rows": rows,
+                            "batch_requests": len(batch),
+                        },
+                    )
+                    self.metrics.histogram("serve_latency_seconds").observe(
+                        latency
+                    )
+                    self.metrics.histogram("queue_wait_seconds").observe(
+                        queue_wait
+                    )
+                    self.metrics.histogram("batch_wait_seconds").observe(
+                        batch_wait
+                    )
+                    self.metrics.histogram("device_seconds").observe(device_s)
+                    req.future.set_result(result)
                     self._completed += 1
+                    s = e
+            except BaseException as e:  # fail the whole batch, keep serving
+                sp.set(failed=True, error=type(e).__name__)
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                        self._completed += 1
 
     # -- introspection -------------------------------------------------------
 

@@ -1,0 +1,567 @@
+"""Request-lifecycle tracing + open-loop load generation (ISSUE 7).
+
+Pins the acceptance criteria: per-request queue_wait + batch_wait + device
+sums to serve_latency (exactly — same clock reads; the criterion's 5% bound
+is slack); serve_batch spans carry their request-id lists; the Perfetto
+export links >= 1 request submit instant to its serving batch span via
+``ph:"s"``/``ph:"f"`` flow events; loadgen's client-side quantiles agree
+with the /metrics histogram quantiles within one bucket; the serving_slo
+ladder emits goodput + rejection rate + p50/p99/p999 at >= 3 offered rates;
+and ``bench_diff --gate p99:...`` exits 3 on an injected regression.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.obs import MetricsRegistry, Tracer, chrome_trace_events
+from consensusclustr_tpu.obs.hist import (
+    DEFAULT_BUCKET_RATIO,
+    log_bounds,
+    merge_bucket_counts,
+)
+from consensusclustr_tpu.obs.metrics import Histogram
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_artifact(n=48, n_genes=12, d=4, seed=0):
+    from consensusclustr_tpu.serve.artifact import (
+        ReferenceArtifact,
+        level_tables,
+    )
+    from consensusclustr_tpu.serve.assign import embed_reference_counts
+
+    rng = np.random.default_rng(seed)
+    loadings = np.linalg.qr(rng.normal(size=(n_genes, d)))[0].astype(np.float32)
+    mu = np.zeros(n_genes, np.float32)
+    sigma = np.ones(n_genes, np.float32)
+    counts = rng.poisson(3.0, size=(n, n_genes)).astype(np.float32)
+    libsize_mean = float(counts.sum(1).mean())
+    emb = embed_reference_counts(counts, mu, sigma, loadings, libsize_mean)
+    codes, tables = level_tables(
+        np.asarray([str(i % 3 + 1) for i in range(n)], dtype=object)
+    )
+    art = ReferenceArtifact(
+        embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+        libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+        stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+    )
+    return art, counts
+
+
+# -----------------------------------------------------------------------------
+# stdlib schedule / mix / quantile core
+# -----------------------------------------------------------------------------
+
+
+class TestScheduleCore:
+    def setup_method(self):
+        self.lg = _load_tool("loadgen")
+
+    def test_parse_sizes(self):
+        mix = self.lg.parse_sizes("1:0.5,4:0.3,16:0.2")
+        assert [s for s, _ in mix] == [1, 4, 16]
+        assert abs(sum(w for _, w in mix) - 1.0) < 1e-12
+        assert self.lg.parse_sizes("8") == [(8, 1.0)]
+        with pytest.raises(ValueError):
+            self.lg.parse_sizes("0:1")
+        with pytest.raises(ValueError):
+            self.lg.parse_sizes("")
+
+    def test_schedule_reproducible_and_bounded(self):
+        a = self.lg.schedule_offsets(50.0, seed=3, duration=2.0)
+        b = self.lg.schedule_offsets(50.0, seed=3, duration=2.0)
+        assert a == b and all(0 < t < 2.0 for t in a)
+        assert a == sorted(a)
+        c = self.lg.schedule_offsets(50.0, seed=4, count=37)
+        assert len(c) == 37
+
+    @pytest.mark.parametrize("process", ["poisson", "lognormal"])
+    def test_mean_inter_arrival_tracks_rate(self, process):
+        offs = self.lg.schedule_offsets(
+            100.0, process=process, seed=0, count=4000
+        )
+        mean = offs[-1] / len(offs)
+        assert 0.8 / 100.0 < mean < 1.25 / 100.0, (process, mean)
+
+    def test_lognormal_is_heavier_tailed(self):
+        import random
+
+        rnd_p, rnd_l = random.Random(0), random.Random(0)
+        p = [self.lg.inter_arrival(50.0, "poisson", 1.5, rnd_p)
+             for _ in range(4000)]
+        l = [self.lg.inter_arrival(50.0, "lognormal", 1.5, rnd_l)
+             for _ in range(4000)]
+        assert max(l) > max(p)  # same mean, fatter tail
+
+    def test_exact_quantile_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        xs = rng.exponential(1.0, 500).tolist()
+        for q in (0.0, 0.5, 0.99, 0.999, 1.0):
+            assert self.lg.exact_quantile(xs, q) == pytest.approx(
+                float(np.percentile(xs, 100.0 * q)), rel=1e-9
+            )
+        assert self.lg.exact_quantile([], 0.5) is None
+
+
+# -----------------------------------------------------------------------------
+# request lifecycle: decomposition, spans, flow export
+# -----------------------------------------------------------------------------
+
+
+class TestRequestLifecycle:
+    def test_timing_sums_to_latency_exactly(self):
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = _tiny_artifact()
+        rng = np.random.default_rng(1)
+        with AssignmentService(art, max_batch=8) as svc:
+            results = [
+                svc.assign(counts[rng.integers(0, len(counts), 3)])
+                for _ in range(8)
+            ]
+            lat_hist = svc.metrics.histogram("serve_latency_seconds")
+            for name in ("queue_wait_seconds", "batch_wait_seconds",
+                         "device_seconds"):
+                assert svc.metrics.histogram(name).count == lat_hist.count
+            # per-request histogram sums recompose the end-to-end sum
+            total = sum(
+                svc.metrics.histogram(n).sum
+                for n in ("queue_wait_seconds", "batch_wait_seconds",
+                          "device_seconds")
+            )
+            assert total == pytest.approx(lat_hist.sum, rel=1e-9)
+        ids = set()
+        for r in results:
+            t = r.timing
+            assert t is not None
+            assert (
+                t["queue_wait_s"] + t["batch_wait_s"] + t["device_s"]
+                == pytest.approx(t["latency_s"], rel=1e-9)
+            )
+            assert t["queue_wait_s"] >= 0 and t["batch_wait_s"] >= 0
+            assert t["bucket"] >= t["batch_rows"] >= 3
+            ids.add(t["req_id"])
+        assert ids == set(range(1, 9))  # monotonically issued, no gaps
+
+    def test_batch_spans_and_request_events(self):
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = _tiny_artifact()
+        with AssignmentService(art, max_batch=8, warmup=False) as svc:
+            for _ in range(5):
+                svc.assign(counts[:2])
+            rec = svc.run_record()
+        batches = [s for s in rec.spans if s.name == "serve_batch"]
+        assert batches, [s.name for s in rec.spans]
+        served = [rid for s in batches for rid in s.attrs["request_ids"]]
+        assert sorted(served) == [1, 2, 3, 4, 5]
+        for s in batches:
+            assert s.attrs["queue_age_max_s"] >= 0
+            assert s.attrs["bucket"] >= s.attrs["rows"]
+        evs = [e for e in rec.events if e["kind"] == "serve_request"]
+        assert [e["req_id"] for e in evs] == [1, 2, 3, 4, 5]
+
+    def test_direct_assign_has_no_timing(self):
+        from consensusclustr_tpu.serve.assign import assign_cells
+
+        art, counts = _tiny_artifact()
+        assert assign_cells(art, counts[:4]).timing is None
+
+    def test_flow_events_link_request_to_batch(self, tmp_path):
+        """Acceptance: --trace output contains flow events linking >= 1
+        request submit instant to its serving batch span."""
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = _tiny_artifact()
+        with AssignmentService(art, max_batch=8, warmup=False) as svc:
+            for _ in range(4):
+                svc.assign(counts[:2])
+            rec = svc.run_record()
+        path = str(tmp_path / "trace.json")
+        rec.to_chrome_trace(path)
+        events = json.load(open(path))["traceEvents"]
+        starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+        finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+        assert len(starts) >= 1 and set(starts) == set(finishes)
+        batch_lane = {
+            e["tid"] for e in events
+            if e.get("ph") == "X" and e["name"] == "serve_batch"
+        }
+        for rid, s in starts.items():
+            f = finishes[rid]
+            assert f["bp"] == "e" and f["ts"] >= s["ts"]
+            assert f["tid"] in batch_lane  # arrow lands on the batch span
+        # the residency slices live on their own serve_requests lane
+        lanes = {
+            e["args"]["name"]: e["tid"] for e in events
+            if e.get("name") == "thread_name"
+        }
+        assert "serve_requests" in lanes
+        assert all(s["tid"] == lanes["serve_requests"]
+                   for s in starts.values())
+
+    def test_tracer_stacks_are_thread_local(self):
+        tr = Tracer()
+        inner_paths = []
+
+        def worker():
+            with tr.span("serve_batch"):
+                inner_paths.append(tr.span_path())
+                time.sleep(0.02)
+
+        with tr.span("ingest"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            # the worker's open span must not have nested under (or popped)
+            # this thread's span
+            assert tr.span_path() == "ingest"
+        assert inner_paths == ["serve_batch"]
+        assert sorted(s.name for s in tr.roots) == ["ingest", "serve_batch"]
+        assert all(not s.children for s in tr.roots)
+
+
+# -----------------------------------------------------------------------------
+# histogram merge mismatch accounting (satellite)
+# -----------------------------------------------------------------------------
+
+
+class TestHistMergeMismatch:
+    def test_merge_bucket_counts_helper(self):
+        b = log_bounds(1e-3, 1.0)
+        a = [1] * (len(b) + 1)
+        assert merge_bucket_counts(b, a, b, a) == [2] * (len(b) + 1)
+        assert merge_bucket_counts(b, a, log_bounds(1e-2, 1.0), a) is None
+        assert merge_bucket_counts(b, [], b, a) is None
+
+    def test_mismatch_counted_and_summary_exact(self):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.histogram("h").observe(0.5)
+        r2.histograms["h"] = Histogram(bounds=log_bounds(1e-2, 10.0))
+        r2.histogram("h").observe(2.0)
+        r1.merge(r2)
+        h = r1.histograms["h"]
+        assert h.count == 2 and h.sum == pytest.approx(2.5)
+        assert h.quantile(0.5) is None  # buckets invalidated...
+        assert r1.counters["hist_merge_mismatch"].value == 1  # ...but counted
+
+    def test_empty_receiver_adopts_incoming_ladder(self):
+        # RunRecord.from_tracer merges into a fresh registry: a non-default
+        # ladder must survive that round trip, not count as a mismatch
+        src = MetricsRegistry()
+        src.histograms["h"] = Histogram(bounds=log_bounds(1e-2, 10.0))
+        src.histogram("h").observe(0.3)
+        dst = MetricsRegistry()
+        dst.merge(src)
+        assert dst.histograms["h"].quantile(0.5) is not None
+        assert tuple(dst.histograms["h"].bounds) == log_bounds(1e-2, 10.0)
+        assert "hist_merge_mismatch" not in dst.counters
+
+    def test_mismatch_warns_once(self, monkeypatch):
+        # _warn_merge_mismatch resolves get_logger at call time — count the
+        # warning calls directly (the package logger's handler holds a
+        # stream captured at first creation, so fd capture is unreliable)
+        from consensusclustr_tpu.obs import metrics as metrics_mod
+        from consensusclustr_tpu.utils import log as log_mod
+
+        calls = []
+
+        class _Rec:
+            def warning(self, msg, *args):
+                calls.append(msg % args if args else msg)
+
+        monkeypatch.setattr(log_mod, "get_logger", lambda: _Rec())
+        old = metrics_mod._MERGE_MISMATCH_WARNED
+        metrics_mod._MERGE_MISMATCH_WARNED = False
+        try:
+            for _ in range(3):
+                r1, r2 = MetricsRegistry(), MetricsRegistry()
+                r1.histogram("h").observe(0.5)
+                r2.histograms["h"] = Histogram(bounds=log_bounds(1e-2, 10.0))
+                r2.histogram("h").observe(2.0)
+                r1.merge(r2)
+            assert metrics_mod._MERGE_MISMATCH_WARNED is True
+            assert len(calls) == 1
+            assert "mismatched bucket ladders" in calls[0]
+        finally:
+            metrics_mod._MERGE_MISMATCH_WARNED = old
+
+    def test_metric_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        assert "hist_merge_mismatch" in schema.METRIC_NAMES
+
+
+# -----------------------------------------------------------------------------
+# open-loop runs against a live service
+# -----------------------------------------------------------------------------
+
+
+class TestOpenLoop:
+    def setup_method(self):
+        self.lg = _load_tool("loadgen")
+
+    def test_quantile_parity_with_metrics(self):
+        """Acceptance (fast parity): loadgen-side quantiles agree with the
+        /metrics histogram quantiles within one bucket, and the per-request
+        phase decomposition sums within 5% (exactly, in fact)."""
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, _ = _tiny_artifact(n=48, n_genes=12)
+        mix = self.lg.parse_sizes("1:0.5,3:0.5")
+        offsets = self.lg.schedule_offsets(300.0, seed=5, count=40)
+        with AssignmentService(art, max_batch=8, queue_depth=32) as svc:
+            summary = self.lg.run_open_loop(
+                svc, offsets, mix, genes=12, seed=5, timeout=60.0
+            )
+        assert summary["submitted"] == 40
+        assert summary["accepted"] + summary["rejected"] == 40
+        assert summary["completed"] == summary["accepted"]
+        assert summary["goodput_rps"] > 0
+        pp = summary["phase_parity"]
+        assert pp["checked"] == summary["completed"]
+        assert pp["within_5pct"] is True
+        assert pp["max_rel_err"] < 0.05
+        mp = summary["metrics_parity"]
+        assert mp["histogram_count"] == summary["completed"]
+        assert mp["within_one_bucket"] is True
+        for side in ("client", "metrics"):
+            assert mp[f"p50_{side}_ms"] > 0
+
+    def test_rejections_counted_not_retried(self, monkeypatch):
+        from consensusclustr_tpu.serve import service as service_mod
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        real = service_mod.assign_bucketed
+
+        def slow(*a, **k):
+            time.sleep(0.03)
+            return real(*a, **k)
+
+        monkeypatch.setattr(service_mod, "assign_bucketed", slow)
+        art, _ = _tiny_artifact(n=48, n_genes=12)
+        mix = self.lg.parse_sizes("2")
+        # ~0 inter-arrival burst of 24 into a depth-4 queue behind a 30 ms
+        # device: the open loop MUST shed, not retry
+        offsets = self.lg.schedule_offsets(5000.0, seed=0, count=24)
+        with AssignmentService(
+            art, max_batch=4, queue_depth=4, warmup=False
+        ) as svc:
+            summary = self.lg.run_open_loop(
+                svc, offsets, mix, genes=12, seed=0, timeout=60.0
+            )
+        assert summary["rejected"] > 0
+        assert summary["rejection_rate"] == pytest.approx(
+            summary["rejected"] / 24, abs=1e-4
+        )
+        assert summary["accepted"] + summary["rejected"] == 24
+        assert summary["completed"] == summary["accepted"]
+
+    @pytest.mark.slow
+    def test_saturation_ladder(self):
+        """Acceptance (slow): >= 3 offered rates, every step emits goodput,
+        rejection rate and p50/p99/p999 — including the saturated top step."""
+        art, _ = _tiny_artifact(n=64, n_genes=12)
+        mix = self.lg.parse_sizes("1:0.5,4:0.5")
+        ladder = self.lg.slo_ladder(
+            art, rates=(25.0, 100.0, 400.0), duration=1.0, genes=12,
+            mix=mix, seed=1, queue_depth=8, max_batch=8,
+        )
+        assert len(ladder["steps"]) == 3
+        for step in ladder["steps"]:
+            assert "error" not in step, step
+            for key in ("offered_rps", "goodput_rps", "rejection_rate",
+                        "p50_ms", "p99_ms", "p999_ms"):
+                assert key in step
+            assert step["phase_parity"]["within_5pct"] in (True, None)
+        # offered load actually climbs the ladder
+        offered = [s["offered_rps"] for s in ladder["steps"]]
+        assert offered == sorted(offered) and offered[-1] > 2 * offered[0]
+
+    @pytest.mark.slow
+    def test_cli_end_to_end(self, tmp_path):
+        trace = str(tmp_path / "t.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "loadgen.py"),
+             "--rate", "100", "--requests", "30", "--ref-cells", "64",
+             "--genes", "16", "--trace", trace, "--json"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["submitted"] == 30
+        assert summary["phase_parity"]["within_5pct"] is True
+        assert summary["trace"]["flow_links"] >= 1
+        assert os.path.isfile(trace)
+
+
+# -----------------------------------------------------------------------------
+# report.py serving rows (satellite)
+# -----------------------------------------------------------------------------
+
+
+class TestReportServingRows:
+    def test_lifecycle_rows_render(self, tmp_path):
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, counts = _tiny_artifact()
+        with AssignmentService(art, max_batch=8, warmup=False) as svc:
+            for _ in range(6):
+                svc.assign(counts[:2])
+            rec = svc.run_record()
+        path = str(tmp_path / "rec.jsonl")
+        rec.write(path)
+        report = _load_tool("report")
+        assert 5 in report.KNOWN_SCHEMAS
+        out = report.render(json.loads(open(path).read().splitlines()[-1]))
+        assert "queue wait p50" in out and "queue wait p99" in out
+        assert "batch wait p50" in out and "device p99" in out
+
+    def test_rejection_rate_row(self):
+        report = _load_tool("report")
+        hist = {"count": 8, "sum": 0.8, "min": 0.05, "max": 0.2, "mean": 0.1}
+        record = {
+            "metrics": {
+                "histograms": {"serve_latency_seconds": hist},
+                "counters": {"serve_rejections": 2.0},
+            },
+            "wall_s": 1.0,
+        }
+        out = report.serving(record)
+        assert "rejection rate" in out and "0.2000" in out
+
+    def test_absent_keys_stay_guarded(self):
+        report = _load_tool("report")
+        assert report.serving({"metrics": {}}) == "(no serving activity)"
+
+
+# -----------------------------------------------------------------------------
+# bench_diff serving gates + schema fence (satellite)
+# -----------------------------------------------------------------------------
+
+
+def _slo_payload(p99=20.0, rej=0.05, schema=5, **extra):
+    d = {"metric": "m", "value": 1.0, "unit": "boots/s",
+         "obs_schema": schema, "serving_p99_ms": p99,
+         "serve_rejection_rate": rej}
+    d.update(extra)
+    return d
+
+
+class TestBenchDiffServingGates:
+    def _run(self, tmp_path, old, new, *extra):
+        po, pn = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+        json.dump(old, open(po, "w"))
+        json.dump(new, open(pn, "w"))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             po, pn, *extra],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_p99_gate_exits_3_on_injected_regression(self, tmp_path):
+        """Acceptance: bench_diff --gate p99:... exits 3 when the saturation
+        p99 regresses."""
+        bad = self._run(tmp_path, _slo_payload(p99=20.0),
+                        _slo_payload(p99=50.0), "--gate", "p99:0.8")
+        assert bad.returncode == 3
+        assert "serving_p99_ms" in bad.stderr
+        ok = self._run(tmp_path, _slo_payload(p99=20.0),
+                       _slo_payload(p99=21.0), "--gate", "p99:0.8")
+        assert ok.returncode == 0, ok.stderr
+
+    def test_rejection_gate_lower_is_better(self, tmp_path):
+        bad = self._run(tmp_path, _slo_payload(rej=0.02),
+                        _slo_payload(rej=0.2), "--gate", "rejections:0.5")
+        assert bad.returncode == 3
+        assert "serve_rejection_rate" in bad.stderr
+
+    def test_gated_rung_missing_fails_loudly(self, tmp_path):
+        new = _slo_payload()
+        del new["serving_p99_ms"]
+        proc = self._run(tmp_path, _slo_payload(), new, "--gate", "p99:0.8")
+        assert proc.returncode == 1
+        assert "missing" in proc.stderr
+
+    def _run_check(self, tmp_path, s_old, s_new):
+        for name, schema in (("BENCH_r01.json", s_old),
+                             ("BENCH_r02.json", s_new)):
+            json.dump(_slo_payload(schema=schema),
+                      open(str(tmp_path / name), "w"))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             "--check", "--dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_check_relaxes_adjacent_bump_only(self, tmp_path):
+        """The committed r06 (v4) / r07 (v5) pair: --check warns on an
+        adjacent schema bump instead of refusing; a non-adjacent jump still
+        exits 2; explicit-file mode stays strict even for adjacent."""
+        proc = self._run_check(tmp_path, 4, 5)
+        assert proc.returncode == 0, proc.stderr
+        assert "adjacent schema bump" in proc.stderr
+        proc = self._run_check(tmp_path, 3, 5)
+        assert proc.returncode == 2
+        strict = self._run(tmp_path, _slo_payload(schema=4),
+                           _slo_payload(schema=5))
+        assert strict.returncode == 2
+
+
+# -----------------------------------------------------------------------------
+# committed artifacts (the acceptance evidence)
+# -----------------------------------------------------------------------------
+
+
+class TestCommittedArtifacts:
+    def test_loadgen_run_committed(self):
+        """Acceptance: a committed loadgen run shows the phase decomposition
+        summing within 5% per request and >= 1 flow link in its trace."""
+        path = os.path.join(REPO_ROOT, "LOADGEN_r07.json")
+        assert os.path.isfile(path), "LOADGEN_r07.json missing"
+        summary = json.load(open(path))
+        pp = summary["phase_parity"]
+        assert pp["checked"] > 0 and pp["within_5pct"] is True
+        assert pp["max_rel_err"] is not None and pp["max_rel_err"] <= 0.05
+        assert summary["trace"]["flow_links"] >= 1
+        assert summary["metrics_parity"]["within_one_bucket"] is True
+
+    def test_bench_r07_serving_slo(self):
+        """Acceptance: the committed serving_slo rung emits goodput,
+        rejection rate and p50/p99/p999 at >= 3 offered rates."""
+        path = os.path.join(REPO_ROOT, "BENCH_r07.json")
+        assert os.path.isfile(path), "BENCH_r07.json missing"
+        payload = json.load(open(path)).get("parsed")
+        assert payload and payload.get("obs_schema") == 5
+        steps = payload["serving_slo"]["steps"]
+        assert len(steps) >= 3
+        for step in steps:
+            for key in ("goodput_rps", "rejection_rate",
+                        "p50_ms", "p99_ms", "p999_ms"):
+                assert key in step, (key, step)
+        assert payload["serving_p99_ms"] > 0
+        assert "serve_rejection_rate" in payload
+
+    def test_loadgen_covered_by_schema_check(self):
+        check = _load_tool("check_obs_schema")
+        assert os.path.join("tools", "loadgen.py") in check.SCAN
+        assert check.check(REPO_ROOT) == []

@@ -13,6 +13,11 @@ Usage:
         # gate: "rss" aliases peak_rss_mb (lower is better) — the O1
         # peak-memory regression gate; fails when NEW's resource-sampler
         # peak RSS grew past 1/MIN_FACTOR of OLD's
+    python tools/bench_diff.py OLD NEW --gate p99:0.8         # serving SLO
+        # gate: "p99" aliases serving_p99_ms (lower is better) — tail
+        # latency at the saturation step of the open-loop offered-rate
+        # ladder (tools/loadgen.py); "rejections" likewise aliases
+        # serve_rejection_rate
 
 Inputs are either the driver wrapper shape committed at the repo root
 ({"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {bench line}}) or a raw
@@ -31,7 +36,11 @@ Contracts:
     fence exists to catch *known-incompatible* stamps, and permanently
     failing CI on every first post-bump round against an unstamped
     historical artifact would force --allow-schema-drift into the hook,
-    disabling the fence exactly where it matters.
+    disabling the fence exactly where it matters. For the same reason the
+    committed-pair modes (--check/--latest) relax an ADJACENT bump
+    (old + 1 == new) to a warning: every schema-bumping PR lands exactly
+    one such pair in history. Non-adjacent jumps, and any drift between
+    explicitly named files, still refuse.
   * **named-rung gates** — ``--gate RUNG:MIN_FACTOR`` computes a regression
     factor per rung (new/old for higher-is-better rungs, old/new for
     lower-is-better like latency; the direction registry is RUNGS below) and
@@ -84,6 +93,11 @@ RUNGS: Dict[str, int] = {
     "serving.latency_p50_ms": -1,
     "serving.latency_p99_ms": -1,
     "serving.bucket_compiles": -1,
+    # serving-SLO ladder (obs schema v5, ISSUE 7): the saturation step of the
+    # open-loop offered-rate ladder (tools/loadgen.py via bench.py) — p99
+    # under load and the shed fraction are both lower-is-better tail rungs
+    "serving_p99_ms": -1,
+    "serve_rejection_rate": -1,
 }
 
 # Gate-spec shorthands: --gate compiles:0.9 reads better than the full
@@ -94,6 +108,8 @@ RUNG_ALIASES: Dict[str, str] = {
     "rss": "peak_rss_mb",
     "device_mb": "peak_device_mb",
     "flops": "est_flops",
+    "p99": "serving_p99_ms",
+    "rejections": "serve_rejection_rate",
 }
 
 _JSON_LINE = re.compile(r"^\{.*\}$")
@@ -263,6 +279,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(
                 f"bench_diff: warning: unstamped payload in pair "
                 f"({s_old} -> {s_new}); schema fence skipped",
+                file=sys.stderr,
+            )
+        elif (args.check or args.latest) and s_new == s_old + 1:
+            # committed-pair modes tolerate exactly one adjacent bump: the PR
+            # that bumps the schema necessarily lands one cross-version pair
+            # in history forever, and refusing it would force
+            # --allow-schema-drift into the CI hook — disabling the fence
+            # exactly where it matters. Non-adjacent jumps still refuse.
+            print(
+                f"bench_diff: warning: adjacent schema bump in committed "
+                f"pair ({s_old} -> {s_new}); fence relaxed for "
+                "--check/--latest",
                 file=sys.stderr,
             )
         else:

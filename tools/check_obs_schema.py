@@ -62,9 +62,17 @@ ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""
 # exercise the machinery with throwaway names on purpose). The package walk
 # covers every subpackage — serve/ (the online-assignment subsystem, ISSUE 3)
 # included; tests/test_serve.py pins that coverage so a future repo
-# reorganisation cannot silently drop it. Standalone drivers that emit
-# instrumentation are listed explicitly.
-SCAN = ("consensusclustr_tpu", "bench.py", os.path.join("tools", "serve_demo.py"))
+# reorganisation cannot silently drop it. Standalone drivers that emit or
+# read instrumentation by literal name are listed explicitly: serve_demo.py
+# (ISSUE 3) and loadgen.py (ISSUE 7 — its /metrics parity check reads
+# histograms by name; a typo'd literal there would silently parity-check
+# an always-empty series).
+SCAN = (
+    "consensusclustr_tpu",
+    "bench.py",
+    os.path.join("tools", "serve_demo.py"),
+    os.path.join("tools", "loadgen.py"),
+)
 
 
 def _py_files(root: str) -> List[str]:

@@ -1,0 +1,529 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serve/ AssignmentService (ISSUE 7).
+
+    python tools/loadgen.py --rate 50 --duration 3        # Poisson arrivals
+    python tools/loadgen.py --rate 30 --requests 200 --process lognormal
+    python tools/loadgen.py --ladder 20,40,80 --duration 2 --json
+    python tools/loadgen.py --rate 50 --duration 3 --trace trace.json \
+        --record run.jsonl                                # -> tools/report.py
+
+**Open loop**: requests fire on a pre-drawn arrival schedule regardless of
+completions — the generator never waits for a response before sending the
+next request, so offered load stays fixed while the service saturates. That
+is the property a serving SLO needs: a closed loop self-throttles at
+saturation and reports flattering latencies; an open loop exposes the real
+queue growth, rejection rate, and tail. Backpressure rejections are counted,
+**not retried** (a retry would couple the arrival process to service state).
+
+Arrival processes (seeded, ``random.Random`` — reproducible):
+
+  * ``poisson``   — exponential inter-arrivals at ``--rate`` req/s;
+  * ``lognormal`` — heavy-tail inter-arrivals with the same mean (1/rate)
+    and shape ``--sigma`` (default 1.5): bursts + gaps at equal offered load.
+
+Request sizes draw from a weighted mix (``--sizes 1:0.5,4:0.3,16:0.2``), so
+one run exercises several compile buckets the way mixed traffic does.
+
+Reported per run (and per ladder step): offered load (achieved submit rate),
+goodput (completions/s), rejection rate, and client-side p50/p99/p999 —
+measured by the generator's own clock, deliberately independent of the
+service's histograms so the two can be parity-checked (the ``metrics_parity``
+block compares them; they must agree within one histogram bucket). Each
+result's ``AssignResult.timing`` decomposition is audited too: the
+``phase_parity`` block proves per-request queue_wait + batch_wait + device
+sums to the end-to-end latency.
+
+The schedule/quantile/mix helpers are stdlib-only and importable without
+numpy or the package (bench.py and the tests reuse them); only the driver
+functions that build artifacts and query matrices need the stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+DEFAULT_SIZES = "1:0.5,4:0.3,16:0.2"
+DEFAULT_SIGMA = 1.5
+PHASE_PARITY_TOL = 0.05  # the acceptance bound: sum within 5% of latency
+
+
+# -- stdlib core: schedules, mixes, quantiles ---------------------------------
+
+
+def parse_sizes(spec: str) -> List[Tuple[int, float]]:
+    """``"1:0.5,4:0.3,16:0.2"`` -> [(1, .5), (4, .3), (16, .2)]; weights are
+    normalized, a bare ``"8"`` means all requests have 8 rows."""
+    out: List[Tuple[int, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        size, _, weight = part.partition(":")
+        out.append((int(size), float(weight) if weight else 1.0))
+    if not out or any(s < 1 or w < 0 for s, w in out):
+        raise ValueError(f"bad --sizes spec {spec!r}")
+    total = sum(w for _, w in out)
+    if total <= 0:
+        raise ValueError(f"--sizes weights sum to 0: {spec!r}")
+    return [(s, w / total) for s, w in out]
+
+
+def pick_size(mix: Sequence[Tuple[int, float]], rnd: random.Random) -> int:
+    u = rnd.random()
+    cum = 0.0
+    for size, w in mix:
+        cum += w
+        if u <= cum:
+            return size
+    return mix[-1][0]
+
+
+def inter_arrival(
+    rate: float, process: str, sigma: float, rnd: random.Random
+) -> float:
+    """One inter-arrival draw with mean 1/rate seconds."""
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0; got {rate}")
+    if process == "poisson":
+        return rnd.expovariate(rate)
+    if process == "lognormal":
+        # ln-space mean chosen so E[X] = 1/rate regardless of sigma
+        mu = math.log(1.0 / rate) - 0.5 * sigma * sigma
+        return rnd.lognormvariate(mu, sigma)
+    raise ValueError(f"unknown arrival process {process!r}")
+
+
+def schedule_offsets(
+    rate: float,
+    process: str = "poisson",
+    sigma: float = DEFAULT_SIGMA,
+    seed: int = 0,
+    duration: Optional[float] = None,
+    count: Optional[int] = None,
+) -> List[float]:
+    """Arrival offsets (seconds from start): fixed-duration (all arrivals
+    inside ``duration``) or fixed-count (exactly ``count`` arrivals). Seeded
+    and pre-drawn, so a run's offered traffic is reproducible and independent
+    of how the service responds (the open-loop contract)."""
+    if (duration is None) == (count is None):
+        raise ValueError("exactly one of duration/count must be given")
+    rnd = random.Random(seed)
+    out: List[float] = []
+    t = 0.0
+    while True:
+        t += inter_arrival(rate, process, sigma, rnd)
+        if duration is not None and t >= duration:
+            return out
+        out.append(t)
+        if count is not None and len(out) >= count:
+            return out
+
+
+def exact_quantile(samples: Sequence[float], q: float) -> Optional[float]:
+    """Linear-interpolation sample quantile (np.percentile's default method,
+    stdlib-only so report tooling can reuse it)."""
+    if not samples:
+        return None
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"q must be in [0, 1]; got {q}")
+    s = sorted(samples)
+    pos = q * (len(s) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] + (pos - lo) * (s[hi] - s[lo])
+
+
+def _quantiles_ms(samples: Sequence[float]) -> Dict[str, Optional[float]]:
+    out = {}
+    for label, q in (("p50", 0.5), ("p99", 0.99), ("p999", 0.999)):
+        v = exact_quantile(samples, q)
+        out[f"{label}_ms"] = round(1000.0 * v, 3) if v is not None else None
+    return out
+
+
+# -- drivers (need numpy + the package) ---------------------------------------
+
+
+def synthetic_artifact(n_ref: int = 2048, genes: int = 256, seed: int = 0):
+    """Synthetic frozen reference for serving micro-benches: random orthonormal
+    loadings + random labels (same recipe as bench.py's serving rung — serving
+    MECHANICS don't depend on fit quality). Returns (artifact, rng)."""
+    import numpy as np
+
+    from consensusclustr_tpu.serve.artifact import (
+        ReferenceArtifact,
+        level_tables,
+    )
+    from consensusclustr_tpu.serve.assign import embed_reference_counts
+
+    rng = np.random.default_rng(seed)
+    d, n_classes = 10, 8
+    loadings = np.linalg.qr(rng.normal(size=(genes, d)))[0].astype(np.float32)
+    mu = rng.gamma(1.0, 1.0, genes).astype(np.float32)
+    sigma = np.ones(genes, np.float32)
+    ref_counts = rng.poisson(2.0, size=(n_ref, genes)).astype(np.float32)
+    libsize_mean = float(ref_counts.sum(axis=1).mean())
+    emb = embed_reference_counts(ref_counts, mu, sigma, loadings, libsize_mean)
+    codes, tables = level_tables(
+        np.asarray([str(c + 1) for c in rng.integers(0, n_classes, n_ref)])
+    )
+    art = ReferenceArtifact(
+        embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+        libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+        stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+    )
+    return art, rng
+
+
+def _query_pool(genes: int, mix, seed: int):
+    """A few pre-built query matrices per size: drawing from a pool keeps
+    per-submit host work constant so the arrival schedule stays honest."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    pool = {
+        size: [
+            rng.poisson(2.0, size=(size, genes)).astype(np.float32)
+            for _ in range(4)
+        ]
+        for size, _ in mix
+    }
+    return pool
+
+
+def run_open_loop(
+    svc,
+    offsets: Sequence[float],
+    mix: Sequence[Tuple[int, float]],
+    genes: int,
+    seed: int = 0,
+    timeout: float = 120.0,
+) -> dict:
+    """Fire the schedule at ``svc``, wait for the stragglers, summarize.
+
+    Never retries a rejection (open loop); a request that would exceed
+    ``serve_max_batch`` is a configuration error and raises upfront.
+    """
+    from consensusclustr_tpu.serve.service import RetryableRejection
+
+    if any(size > svc.max_batch for size, _ in mix):
+        raise ValueError(
+            f"size mix {mix} exceeds serve_max_batch={svc.max_batch}"
+        )
+    rnd = random.Random(seed)
+    pool = _query_pool(genes, mix, seed)
+    lat: List[float] = []          # client-measured latency per completion
+    timings: List[dict] = []       # AssignResult.timing per completion
+    failures = [0]
+    pending = []
+    rejected = 0
+    max_lag = 0.0
+    t0 = time.perf_counter()
+    for off in offsets:
+        now = time.perf_counter() - t0
+        if off > now:
+            time.sleep(off - now)
+        else:
+            max_lag = max(max_lag, now - off)
+        q = rnd.choice(pool[pick_size(mix, rnd)])
+        t_sub = time.perf_counter()
+        try:
+            fut = svc.submit(q)
+        except RetryableRejection:
+            rejected += 1
+            continue
+
+        def _done(f, t_sub=t_sub):
+            t_end = time.perf_counter()
+            exc = f.exception()
+            if exc is not None:
+                failures[0] += 1
+                return
+            lat.append(t_end - t_sub)
+            timing = getattr(f.result(), "timing", None)
+            if timing:
+                timings.append(timing)
+
+        fut.add_done_callback(_done)
+        pending.append(fut)
+    submit_window = time.perf_counter() - t0
+    deadline = time.monotonic() + timeout
+    for fut in pending:
+        fut.result(timeout=max(deadline - time.monotonic(), 0.001))
+    wall = time.perf_counter() - t0
+
+    submitted = len(offsets)
+    accepted = len(pending)
+    completed = len(lat)
+    summary = {
+        "submitted": submitted,
+        "accepted": accepted,
+        "rejected": rejected,
+        "failed": failures[0],
+        "completed": completed,
+        "wall_s": round(wall, 3),
+        "max_lag_s": round(max_lag, 4),
+        # achieved submit rate over the submit window — the offered load the
+        # service actually saw (vs the nominal --rate target)
+        "offered_rps": round(submitted / submit_window, 2)
+        if submit_window > 0 else 0.0,
+        "goodput_rps": round(completed / wall, 2) if wall > 0 else 0.0,
+        "rejection_rate": round(rejected / submitted, 4) if submitted else 0.0,
+        **_quantiles_ms(lat),
+        "phase_parity": phase_parity(timings),
+        "metrics_parity": metrics_parity(svc, lat),
+    }
+    return summary
+
+
+def phase_parity(timings: Sequence[dict]) -> dict:
+    """Audit the per-request decomposition: queue_wait + batch_wait + device
+    must equal latency (within PHASE_PARITY_TOL relative — the acceptance
+    bound; in practice it is exact, the service derives all four from the
+    same clock reads)."""
+    errs = []
+    for t in timings:
+        latency = t.get("latency_s") or 0.0
+        if latency <= 0:
+            continue
+        total = (
+            t.get("queue_wait_s", 0.0)
+            + t.get("batch_wait_s", 0.0)
+            + t.get("device_s", 0.0)
+        )
+        errs.append(abs(total - latency) / latency)
+    if not errs:
+        return {"checked": 0, "max_rel_err": None, "within_5pct": None}
+    return {
+        "checked": len(errs),
+        "max_rel_err": round(max(errs), 6),
+        "within_5pct": bool(max(errs) <= PHASE_PARITY_TOL),
+    }
+
+
+def metrics_parity(svc, client_lat: Sequence[float]) -> dict:
+    """Client-side quantiles vs the service's bucketed serve_latency_seconds
+    histogram (the same numbers /metrics scrapes): each pair must agree
+    within one histogram bucket step — the generator's independent clock is
+    the check on the service's own accounting."""
+    from consensusclustr_tpu.obs.hist import DEFAULT_BUCKET_RATIO
+
+    hist = svc.metrics.histogram("serve_latency_seconds")
+    out: dict = {"histogram_count": hist.count}
+    within = []
+    for label, q in (("p50", 0.5), ("p99", 0.99)):
+        client = exact_quantile(client_lat, q)
+        est = hist.quantile(q)
+        out[f"{label}_client_ms"] = (
+            round(1000.0 * client, 3) if client is not None else None
+        )
+        out[f"{label}_metrics_ms"] = (
+            round(1000.0 * est, 3) if est is not None else None
+        )
+        if client is not None and est is not None and est > 0:
+            r = DEFAULT_BUCKET_RATIO * 1.02  # one bucket + rounding slack
+            within.append(est / r <= client <= est * r)
+    out["within_one_bucket"] = bool(within) and all(within)
+    return out
+
+
+def estimate_capacity(
+    svc, mix, genes: int, seed: int = 0, n_requests: int = 32
+) -> float:
+    """Closed-loop capacity probe: sequential submits, requests/sec. The SLO
+    ladder scales its offered rates off this so a "2x saturation" step means
+    the same thing on a laptop CPU and a TPU host."""
+    rnd = random.Random(seed)
+    pool = _query_pool(genes, mix, seed + 1)
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        svc.assign(rnd.choice(pool[pick_size(mix, rnd)]))
+    return n_requests / (time.perf_counter() - t0)
+
+
+def slo_ladder(
+    artifact,
+    rates: Sequence[float],
+    duration: float,
+    genes: int,
+    mix: Sequence[Tuple[int, float]],
+    seed: int = 0,
+    process: str = "poisson",
+    sigma: float = DEFAULT_SIGMA,
+    queue_depth: int = 16,
+    max_batch: int = 64,
+    timeout: float = 120.0,
+) -> dict:
+    """One open-loop run per offered rate, fresh service each step (clean
+    histograms; jit caches persist process-wide so only step 1 pays warmup).
+    Every step emits goodput + rejection rate + p50/p99/p999 — including
+    saturated steps; the failure shape of a step is an ``error`` key, never
+    a missing step."""
+    from consensusclustr_tpu.serve.service import AssignmentService
+
+    steps = []
+    for i, rate in enumerate(rates):
+        step = {"target_rps": round(float(rate), 2)}
+        try:
+            offsets = schedule_offsets(
+                rate, process=process, sigma=sigma, seed=seed + i,
+                duration=duration,
+            )
+            with AssignmentService(
+                artifact, max_batch=max_batch, queue_depth=queue_depth,
+            ) as svc:
+                step.update(
+                    run_open_loop(
+                        svc, offsets, mix, genes, seed=seed + i,
+                        timeout=timeout,
+                    )
+                )
+        except Exception as e:  # the rung must emit every step
+            step["error"] = str(e)[:200]
+        steps.append(step)
+    return {"steps": steps, "duration_s": duration, "process": process}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="offered rate, requests/sec (default 50)")
+    ap.add_argument("--ladder", default=None, metavar="R1,R2,...",
+                    help="run an offered-rate ladder instead of one rate")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds of offered traffic (default: 3, unless "
+                         "--requests is given)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="fixed request count instead of fixed duration")
+    ap.add_argument("--process", choices=("poisson", "lognormal"),
+                    default="poisson")
+    ap.add_argument("--sigma", type=float, default=DEFAULT_SIGMA,
+                    help="lognormal shape (heavier tail when larger)")
+    ap.add_argument("--sizes", default=DEFAULT_SIZES,
+                    help=f"size:weight mix (default {DEFAULT_SIZES})")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ref-cells", type=int, default=2048)
+    ap.add_argument("--genes", type=int, default=256)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--queue-depth", type=int, default=16)
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="straggler wait after the schedule ends")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="export the service trace (flow-linked, "
+                         "ui.perfetto.dev) and report the link count")
+    ap.add_argument("--record", metavar="OUT.jsonl", default=None,
+                    help="append the service RunRecord (-> tools/report.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line")
+    args = ap.parse_args(argv)
+
+    if args.duration is not None and args.requests is not None:
+        ap.error("--duration and --requests are mutually exclusive")
+    duration = args.duration if args.duration is not None else (
+        None if args.requests is not None else 3.0
+    )
+    mix = parse_sizes(args.sizes)
+
+    from consensusclustr_tpu.serve.service import AssignmentService
+
+    art, _ = synthetic_artifact(args.ref_cells, args.genes, seed=args.seed)
+
+    if args.ladder:
+        rates = [float(r) for r in args.ladder.split(",") if r.strip()]
+        summary = slo_ladder(
+            art, rates, duration or 3.0, args.genes, mix, seed=args.seed,
+            process=args.process, sigma=args.sigma,
+            queue_depth=args.queue_depth, max_batch=args.max_batch,
+            timeout=args.timeout,
+        )
+        summary["mode"] = "ladder"
+    else:
+        offsets = schedule_offsets(
+            args.rate, process=args.process, sigma=args.sigma,
+            seed=args.seed, duration=duration, count=args.requests,
+        )
+        with AssignmentService(
+            art, max_batch=args.max_batch, queue_depth=args.queue_depth,
+        ) as svc:
+            summary = run_open_loop(
+                svc, offsets, mix, args.genes, seed=args.seed,
+                timeout=args.timeout,
+            )
+            summary["mode"] = "open_loop"
+            summary["target_rps"] = args.rate
+            rec = svc.run_record()
+        if args.record:
+            rec.write(args.record)
+            summary["record"] = args.record
+        if args.trace:
+            rec.to_chrome_trace(args.trace)
+            with open(args.trace) as f:
+                events = json.load(f).get("traceEvents", [])
+            summary["trace"] = {
+                "path": args.trace,
+                "flow_links": sum(1 for e in events if e.get("ph") == "s"),
+                "batch_spans": sum(
+                    1 for e in events
+                    if e.get("ph") == "X" and e.get("name") == "serve_batch"
+                ),
+            }
+    summary["process"] = args.process
+    summary["seed"] = args.seed
+    summary["sizes"] = args.sizes
+
+    if args.json:
+        print(json.dumps(summary))
+        return 0
+    if args.ladder:
+        print(f"{'target':>8} {'offered':>8} {'goodput':>8} {'reject':>7} "
+              f"{'p50ms':>8} {'p99ms':>8} {'p999ms':>8}")
+        for s in summary["steps"]:
+            if "error" in s:
+                print(f"{s['target_rps']:>8} ERROR {s['error']}")
+                continue
+            print(f"{s['target_rps']:>8} {s['offered_rps']:>8} "
+                  f"{s['goodput_rps']:>8} {s['rejection_rate']:>7.3f} "
+                  f"{s['p50_ms'] or 0:>8} {s['p99_ms'] or 0:>8} "
+                  f"{s['p999_ms'] or 0:>8}")
+        return 0
+    print(f"offered {summary['offered_rps']} rps "
+          f"(target {summary['target_rps']}), "
+          f"goodput {summary['goodput_rps']} rps, "
+          f"rejection {summary['rejection_rate']:.3f}")
+    print(f"latency p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "
+          f"p999={summary['p999_ms']}ms over {summary['completed']} ok")
+    pp = summary["phase_parity"]
+    print(f"phase parity: {pp['checked']} checked, "
+          f"max_rel_err={pp['max_rel_err']} within_5pct={pp['within_5pct']}")
+    mp = summary["metrics_parity"]
+    print(f"/metrics parity: p50 {mp['p50_client_ms']} vs "
+          f"{mp['p50_metrics_ms']} ms, p99 {mp['p99_client_ms']} vs "
+          f"{mp['p99_metrics_ms']} ms, "
+          f"within_one_bucket={mp['within_one_bucket']}")
+    if "trace" in summary:
+        tr = summary["trace"]
+        print(f"trace -> {tr['path']}: {tr['flow_links']} flow links, "
+              f"{tr['batch_spans']} batch spans (open in ui.perfetto.dev)")
+    if "record" in summary:
+        print(f"record -> {summary['record']} "
+              f"(render: python tools/report.py {summary['record']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

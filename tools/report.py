@@ -31,7 +31,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -196,12 +196,37 @@ def serving(record: dict) -> str:
                 lines.append(
                     f"{'latency ' + label + ' (ms, est)':<28} {1000.0 * v:.3f}"
                 )
+        # schema >= 5: the request-lifecycle decomposition (queue wait /
+        # batch-formation wait / device share — per request these sum to the
+        # end-to-end latency above). Absent on older records; never an error.
+        for key, label in (
+            ("queue_wait_seconds", "queue wait"),
+            ("batch_wait_seconds", "batch wait"),
+            ("device_seconds", "device"),
+        ):
+            phase = (m.get("histograms") or {}).get(key)
+            if not phase or not phase.get("count"):
+                continue
+            for q, qlabel in ((0.5, "p50"), (0.99, "p99")):
+                v = exp.prom_quantile(phase, q)
+                if v is not None:
+                    lines.append(
+                        f"{label + ' ' + qlabel + ' (ms, est)':<28} "
+                        f"{1000.0 * v:.3f}"
+                    )
     for label, key in (
         ("bucket compiles", "serve_compile"),
         ("rejections", "serve_rejections"),
     ):
         if key in counters:
             lines.append(f"{label:<28} {counters[key]:g}")
+    if "serve_rejections" in counters:
+        offered = n + counters["serve_rejections"]
+        if offered:
+            lines.append(
+                f"{'rejection rate':<28} "
+                f"{counters['serve_rejections'] / offered:.4f}"
+            )
     for key in ("queue_depth", "batch_occupancy"):
         if gauges.get(key) is not None:
             lines.append(f"{key + ' (last)':<28} {gauges[key]:g}")

@@ -43,6 +43,16 @@ reports no memory stats) and ``est_flops`` (delta of the counting_jit
 rss:...`` turns peak_rss_mb into the O1 peak-memory regression gate.
 BENCH_BALLAST_MB pins a deliberate host allocation for the run — the knob
 that proves the gate can see an O1-scale regression.
+
+Numerics accounting (obs schema v6, ISSUE 8): every rung also carries
+``labels_fingerprint`` — the obs/fingerprint.py order-independent 64-bit
+checksum of the rung's label output (final assignments for pbmc3k, consensus
+labels for granular, the boot label matrix for the default rung; null on the
+failure rung). ``tools/bench_diff.py --gate parity`` exits 3 when the
+fingerprint drifts between two same-schema rounds — a label-level numeric
+regression gate riding the existing bench trajectory. Setting CCTPU_NUMERICS
+additionally threads watch/audit checkpoints through the measured run
+itself.
 """
 
 from __future__ import annotations
@@ -250,6 +260,25 @@ def _serving_slo_rung() -> dict:
         return out
 
 
+def _labels_fingerprint(labels) -> "str | None":
+    """Order-independent 64-bit checksum (obs/fingerprint.py) of a rung's
+    label output — the per-rung parity surface ``tools/bench_diff.py
+    --gate parity`` compares across rounds (obs schema v6). String labels
+    fingerprint through their sorted-unique integer codes; any failure
+    (including the package not importing on the failure rung) reports None,
+    and the parity gate treats a missing fingerprint as a loud error, not a
+    pass."""
+    try:
+        from consensusclustr_tpu.obs.fingerprint import array_fingerprint
+
+        labels = np.asarray(labels)
+        if labels.dtype.kind not in "biufc":
+            labels = np.unique(labels, return_inverse=True)[1]
+        return array_fingerprint(labels.astype(np.int32))["checksum"]
+    except Exception:
+        return None
+
+
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
@@ -415,6 +444,7 @@ def _run_pbmc3k() -> dict:
         "n_clusters": int(res.n_clusters),
         "ari_vs_truth": round(ari, 4),
         "boots_per_sec": round(nboots / dt, 3),
+        "labels_fingerprint": _labels_fingerprint(res.assignments),
         "phases": phases,
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(
@@ -480,6 +510,7 @@ def _run_granular() -> dict:
         # pallas/einsum dispatch is not in play here
         "path": "blockwise",
         "boots_per_sec": round(nboots / dt, 3),
+        "labels_fingerprint": _labels_fingerprint(res.labels),
         "candidate_rows": b_eff,
         "n_clusters": int(res.n_clusters),
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
@@ -562,13 +593,14 @@ def _run() -> dict:
                     use_pallas=cfg.use_pallas,
                 )
             sp.value = dist
-        return jax.block_until_ready(dist)
+        jax.block_until_ready(dist)
+        return labels
 
     run(Tracer())  # warmup: compiles the exact chunk shapes the timed run uses
 
     tracer = Tracer()
     t0 = time.perf_counter()
-    run(tracer)
+    timed_labels = run(tracer)
     dt = time.perf_counter() - t0
     boots_per_sec = nboots / dt
     # snapshot BEFORE the parity block below: its small dispatch also sets
@@ -609,6 +641,9 @@ def _run() -> dict:
         "cells": n,
         "boots": nboots,
         "wall_s": round(dt, 3),
+        # parity surface: the timed run's boot label rows (this rung has no
+        # final consensus labels — the boot matrix IS its label output)
+        "labels_fingerprint": _labels_fingerprint(timed_labels),
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
@@ -807,6 +842,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": err.strip().splitlines()[-1][:300],
             # failure rung stays schema-comparable: empty phases, same keys
+            "labels_fingerprint": None,
             "phases": {},
             "pipeline_depth": _pipeline_depth(),
             "overlap_ratio": 0.0,

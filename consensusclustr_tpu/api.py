@@ -36,6 +36,14 @@ from consensusclustr_tpu.obs import (
     maybe_span,
     record_device_memory,
 )
+from consensusclustr_tpu.obs.fingerprint import (
+    HVG_CKPT,
+    LABELS_CKPT,
+    NORM_CKPT,
+    PCA_CKPT,
+    attach_numerics,
+    numeric_checkpoint,
+)
 from consensusclustr_tpu.consensus.pipeline import ConsensusResult, consensus_cluster
 from consensusclustr_tpu.hierarchy.clustree import hierarchy_edges, hierarchy_table
 from consensusclustr_tpu.hierarchy.dendro import Dendrogram, determine_hierarchy
@@ -450,6 +458,12 @@ def _level_impl(
                 sf = compute_size_factors(counts_dev, cfg.size_factors)
                 norm = shifted_log(counts_dev, sf)
 
+        # numerics checkpoint: post-normalization, pre-HVG. Sparse norm stays
+        # host CSR until after the HVG subset, so it is fingerprinted at the
+        # hvg checkpoint instead (docs/perf.md "Auditing numerical parity").
+        if norm is not None and not _is_sparse(norm):
+            numeric_checkpoint(log, NORM_CKPT, norm)
+
         # --- HVG selection (:291-304) -----------------------------------------
         n_genes = ing.counts.shape[1] if ing.counts is not None else (
             norm.shape[1] if norm is not None else 0
@@ -476,6 +490,11 @@ def _level_impl(
         # [n, n_var_features] and safely materialisable
         if _is_sparse(norm):
             norm = jnp.asarray(np.asarray(norm.todense(), np.float32))
+        # numerics checkpoint: the HVG-subset matrix that feeds PCA (the
+        # sparse path fingerprints here too — post-densify is the first
+        # point its values live on device)
+        if norm is not None:
+            numeric_checkpoint(log, HVG_CKPT, norm)
         log.event("prep", n_genes_kept=int(norm.shape[1]) if norm is not None else 0)
 
     # --- covariate regression (:306-319) ----------------------------------
@@ -573,6 +592,10 @@ def _level_impl(
                     [pca, np.zeros((pca.shape[0], d_pad - pca.shape[1]), np.float32)],
                     axis=1,
                 )
+        # numerics checkpoint: the embedding every downstream boot sees (the
+        # deliberate --inject bf16:pca target in tools/parity_audit.py's
+        # self-test lands here)
+        numeric_checkpoint(log, PCA_CKPT, pca)
         log.event("pca", pc_num=int(pc_num))
 
     # --- serving capture (serve/, ISSUE 3) --------------------------------
@@ -842,6 +865,12 @@ def consensus_clust(
         progress=cfg.progress,
         annotate=bool(os.environ.get("CCTPU_SPAN_ANNOTATE")),
     )
+    # Numerics observability (obs/fingerprint.py): off unless cfg.numerics /
+    # CCTPU_NUMERICS asks — with no monitor attached every
+    # numeric_checkpoint call in the pipeline returns before touching (or
+    # even materialising) its array, so the default path stays
+    # dispatch-identical to a build without the layer.
+    attach_numerics(tracer, cfg.numerics)
     log = LevelLog(enabled=cfg.progress, tracer=tracer)
     key = root_key(cfg.seed)
 
@@ -946,6 +975,14 @@ def _consensus_clust_run(
                     np.diagonal(sm)[: len(leaf)], 0.0, 1.0
                 ).astype(np.float32)
             fit = ReferenceFit(stability=stability, **fit_capture)
+
+    # numerics checkpoint: the run's final assignments (string lineage
+    # labels fingerprinted through their sorted-unique integer codes — two
+    # regimes agreeing here agree on every cell's cluster)
+    numeric_checkpoint(
+        log, LABELS_CKPT,
+        lambda: np.unique(labels, return_inverse=True)[1].astype(np.int32),
+    )
 
     # --- run record (obs/): span tree + events + metrics snapshot ---------
     if sampler is not None:

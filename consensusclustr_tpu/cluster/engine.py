@@ -16,6 +16,7 @@ duplicated cells -> first sampled copy; quirk 14).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -242,6 +243,29 @@ def cluster_grid_looped(
         n_clusters=jnp.concatenate(all_nc, axis=0),
         scores=jnp.concatenate(all_scores, axis=0),
     )
+
+
+GRID_IMPLS = ("fused", "looped")
+
+
+def resolve_grid_impl(value: Optional[str] = None) -> str:
+    """Which grid implementation the boot fan-out runs: "fused" (the
+    production vmapped-k program) or "looped" (the per-k parity oracle,
+    bit-identical by the tests/test_fused_grid.py contract). Explicit
+    ``value`` beats the ``CCTPU_GRID_IMPL`` env var beats "fused" —
+    tools/parity_audit.py's ``fused:looped`` pair flips the env var to run
+    the SAME workload through both programs and diff the numeric checkpoint
+    streams."""
+    v = (value or os.environ.get("CCTPU_GRID_IMPL", "") or "fused")
+    v = str(v).strip().lower()
+    if v not in GRID_IMPLS:
+        raise ValueError(f"grid impl must be one of {GRID_IMPLS}; got {v!r}")
+    return v
+
+
+def grid_fn(impl: str):
+    """The cluster-grid entry for a resolved impl name."""
+    return cluster_grid_looped if impl == "looped" else cluster_grid
 
 
 @functools.partial(jax.jit, static_argnames=("n_cells",))

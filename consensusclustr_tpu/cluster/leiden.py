@@ -115,12 +115,18 @@ def _local_moves(
         # remove i's own mass from its current community before comparing
         k_cand = k_cand - jnp.where(cand == labels[:, None], deg[:, None], 0.0)
         gain = k_ic - resolution * deg[:, None] * k_cand / two_m
-        # random tie-break (igraph's beta-noise analog) + partial update mask
+        # random tie-break (igraph's beta-noise analog) + partial update mask.
+        # Draw dtypes are pinned to float32: the defaults widen to float64 on
+        # an x64-enabled host, which changes the drawn bits — and therefore
+        # tie-breaks and labels — between otherwise identical runs (caught by
+        # tools/parity_audit.py --pair x64:x32).
         jitter_key, mask_key = jax.random.split(it_key)
-        gain = gain + 1e-6 * jax.random.uniform(jitter_key, gain.shape)
+        gain = gain + 1e-6 * jax.random.uniform(
+            jitter_key, gain.shape, jnp.float32
+        )
         best = jnp.argmax(gain, axis=1)
         new_labels = jnp.take_along_axis(cand, best[:, None], axis=1)[:, 0]
-        move = jax.random.bernoulli(mask_key, update_frac, (n,))
+        move = jax.random.bernoulli(mask_key, jnp.float32(update_frac), (n,))
         labels = jnp.where(move, new_labels, labels)
         return labels, None
 
@@ -282,10 +288,14 @@ def _coarse_local_moves(
         cand_mass = comm_deg[None, :] - jnp.where(own, k_deg[:, None], 0.0)
         gain = w_cg - resolution * k_deg[:, None] * cand_mass / two_m
         jit_key, mask_key = jax.random.split(it_key)
-        gain = gain + 1e-6 * jax.random.uniform(jit_key, gain.shape)
+        # float32-pinned draws: see the local-move jitter note above
+        gain = gain + 1e-6 * jax.random.uniform(jit_key, gain.shape, jnp.float32)
         # isolated (degree-0 / padding) nodes stay put
         best = jnp.argmax(gain, axis=1).astype(jnp.int32)
-        move = jax.random.bernoulli(mask_key, update_frac, (kk,)) & (k_deg > 0)
+        move = (
+            jax.random.bernoulli(mask_key, jnp.float32(update_frac), (kk,))
+            & (k_deg > 0)
+        )
         return jnp.where(move, best, lab), None
 
     keys = jax.random.split(key, n_iters)

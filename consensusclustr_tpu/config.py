@@ -159,6 +159,15 @@ class ClusterConfig:
     # a socket unless asked (docs/quirks.md). 0 = bind an ephemeral port
     # (the bound port is svc.metrics_port).
     serve_metrics_port: Optional[int] = None
+    # Numerics observability (obs/fingerprint.py): "off" | "watch" | "audit".
+    # None resolves CCTPU_NUMERICS; unset = OFF — checkpoints cost nothing
+    # and dispatch nothing unless asked (docs/quirks.md "Observability
+    # schema v5 → v6"). "watch" runs only the NaN/Inf watchdog
+    # (numerics_nonfinite counter + span tag); "audit" records a device-side
+    # fingerprint (order-independent 64-bit checksum + shape/dtype/min/max/
+    # mean/nan/inf) at every registered pipeline checkpoint — the stream
+    # tools/parity_audit.py diffs across compute regimes.
+    numerics: Optional[str] = None
     # Resource profiling (obs/resource.py): background host-RSS +
     # device-memory sampling interval in milliseconds. None resolves
     # CCTPU_RESOURCE_SAMPLE_MS; unset/0 = OFF — the sampler thread never
@@ -206,6 +215,13 @@ class ClusterConfig:
             v = getattr(self, knob)
             if v is not None and int(v) < 1:
                 raise ValueError(f"{knob} must be >= 1; got {v}")
+        if self.numerics is not None and self.numerics not in (
+            "off", "watch", "audit"
+        ):
+            raise ValueError(
+                f"numerics must be None, 'off', 'watch' or 'audit'; got "
+                f"{self.numerics!r}"
+            )
         if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
             raise ValueError(
                 f"resource_sample_ms must be >= 0 (0 = off); got "

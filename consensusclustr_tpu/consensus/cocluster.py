@@ -208,6 +208,13 @@ class CoclusterAccumulator:
         self.chunks += 1
         self.rows += int(labels.shape[0])
 
+    def carries(self) -> tuple:
+        """The live (agree, union) count carries — the arrays the numerics
+        layer fingerprints at the ``cocluster`` checkpoint (integer counts in
+        f32, so the fingerprint is chunk-order invariant by construction).
+        Read-only view: donating callers must not mutate these."""
+        return self._agree, self._union
+
     def distance(self) -> jax.Array:
         """[n, n] co-clustering distance of everything folded in so far."""
         global LAST_PATH

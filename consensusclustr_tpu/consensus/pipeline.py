@@ -33,6 +33,8 @@ from consensusclustr_tpu.cluster.engine import (
     align_to_cells,
     cluster_grid,
     community_detect,
+    grid_fn,
+    resolve_grid_impl,
     ties_last_argmax as _ties_last_argmax,
 )
 from consensusclustr_tpu.cluster.knn import knn_from_distance
@@ -52,6 +54,13 @@ from consensusclustr_tpu.consensus.merge import (
     merge_unstable_clusters,
 )
 from consensusclustr_tpu.obs import maybe_span, metrics_of, tracer_of
+from consensusclustr_tpu.obs.fingerprint import (
+    BOOT_LABELS_CKPT,
+    COCLUSTER_CKPT,
+    CONSENSUS_DIST_CKPT,
+    LABELS_CKPT,
+    numeric_checkpoint,
+)
 from consensusclustr_tpu.obs.resource import resource_sampling
 from consensusclustr_tpu.parallel.pipelined import (
     AsyncChunkWriter,
@@ -79,7 +88,7 @@ class ConsensusResult(NamedTuple):
 @counting_jit(
     static_argnames=(
         "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
-        "cluster_fun", "compute_dtype",
+        "cluster_fun", "compute_dtype", "grid_impl",
     ),
 )
 def _boot_batch(
@@ -96,12 +105,19 @@ def _boot_batch(
     n_cells: int,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
+    grid_impl: str = "fused",
 ):
-    """One jitted chunk of bootstraps: gather -> grid -> select -> align."""
+    """One jitted chunk of bootstraps: gather -> grid -> select -> align.
+
+    ``grid_impl`` routes through the fused vmapped-k grid (production) or
+    the per-k looped parity oracle (cluster/engine.py) — bit-identical
+    outputs by contract, so flipping it (CCTPU_GRID_IMPL, exercised by
+    tools/parity_audit.py ``--pair fused:looped``) must not move a single
+    numeric checkpoint."""
 
     def one(key_b, idx_b):
         x = pca[idx_b]
-        grid = cluster_grid(
+        grid = grid_fn(grid_impl)(
             key_b, x, res_list, k_list, min_size,
             max_clusters=max_clusters, n_iters=n_iters, cluster_fun=cluster_fun,
             compute_dtype=compute_dtype,
@@ -184,6 +200,7 @@ def run_bootstraps(
     res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
     k_list = tuple(int(k) for k in cfg.k_num)
     robust = cfg.mode == "robust"
+    grid_impl = resolve_grid_impl()
     chunk = _auto_boot_chunk(
         n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list),
         n_k=len(k_list),
@@ -264,12 +281,22 @@ def run_bootstraps(
                 out_labels.append(cached[0].reshape(e - s, rows_per_boot, n))
                 out_scores.append(cached[1].reshape(e - s, rows_per_boot))
             mets.counter("boots_resumed").inc(e - s)
+            # same normalized [rows, n] view as the computed branch, so a
+            # resumed run's checkpoint stream matches a fresh one exactly
+            numeric_checkpoint(
+                log, BOOT_LABELS_CKPT,
+                lambda: np.asarray(cached[0]).reshape(-1, n).astype(np.int32),
+            )
             if log:
                 log.event("boots_resumed", done=e, total=cfg.nboots)
             return
         labels_np, scores_np = ent.fetch()
         out_labels.append(labels_np)
         out_scores.append(scores_np)
+        numeric_checkpoint(
+            log, BOOT_LABELS_CKPT,
+            lambda: np.asarray(labels_np).reshape(-1, n).astype(np.int32),
+        )
         mets.counter("boots_completed").inc(e - s)
         mets.counter("leiden_iters").inc(
             (e - s) * len(k_list) * len(cfg.res_range) * DEFAULT_COMMUNITY_ITERS
@@ -473,6 +500,9 @@ def _finish_consensus(
         labels = merge_unstable_clusters(
             labels, boot_labels, cfg.min_stability, cfg.max_clusters
         )
+        numeric_checkpoint(
+            log, LABELS_CKPT, lambda: np.asarray(labels, np.int32)
+        )
         sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
     metrics_of(log).gauge("silhouette_best").set(sil)
     if log:
@@ -498,6 +528,16 @@ def consensus_cluster(
     n = pca.shape[0]
     res_list = jnp.asarray(list(cfg.res_range), jnp.float32)
     k_list = tuple(int(k) for k in cfg.k_num)
+
+    # Direct callers (bench's granular rung, tests) get the numerics layer
+    # without going through api.consensus_clust: attach to their tracer when
+    # the level asks for it and nothing is attached yet (same courtesy the
+    # resource bracket below extends). An api-attached monitor is reused.
+    _tr = tracer_of(log)
+    if _tr is not None and getattr(_tr, "numerics", None) is None:
+        from consensusclustr_tpu.obs.fingerprint import attach_numerics
+
+        attach_numerics(_tr, cfg.numerics)
 
     mesh = _resolve_mesh(cfg, n, log)
     if mesh is not None:
@@ -564,6 +604,9 @@ def consensus_cluster(
                 np.asarray(esums), np.asarray(ecounts), labels,
                 max(k_list[0], 30),
             )
+        numeric_checkpoint(
+            log, LABELS_CKPT, lambda: np.asarray(labels, np.int32)
+        )
         sil = float(mean_silhouette_score(pca, jnp.asarray(labels), cfg.max_clusters))
         if log:
             log.event("no_boot_result", n_clusters=len(np.unique(labels)), silhouette=sil)
@@ -600,12 +643,18 @@ def consensus_cluster(
                 log, "cocluster", dense=True, streamed=accum is not None
             ) as sp:
                 if accum is not None:
+                    # the streamed count carries, fingerprinted before
+                    # finalize — chunk-order invariant (integer counts)
+                    numeric_checkpoint(
+                        log, COCLUSTER_CKPT, lambda: accum.carries()
+                    )
                     dist = accum.distance()
                 else:
                     dist = coclustering_distance(
                         jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
                         use_pallas=cfg.use_pallas,
                     )
+                numeric_checkpoint(log, CONSENSUS_DIST_CKPT, dist)
                 sp.value = dist
             with maybe_span(log, "consensus_grid") as sp:
                 cons_labels, cons_scores = _consensus_grid(
@@ -624,6 +673,9 @@ def consensus_cluster(
                     jnp.asarray(boot_labels, jnp.int32), max(k_list),
                     cfg.max_clusters, use_pallas=cfg.use_pallas,
                 )
+                # blockwise regime: the [n, n] matrix never exists — the
+                # consensus kNN graph is the comparable downstream artifact
+                numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
                 sp.value = knn_idx
             with maybe_span(log, "consensus_grid") as sp:
                 cons_labels, cons_scores = _consensus_grid_from_knn(

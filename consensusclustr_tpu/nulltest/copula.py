@@ -50,7 +50,9 @@ def _copula_corr(key: jax.Array, counts: jax.Array, mu: jax.Array, theta: jax.Ar
     n = x.shape[0]
     hi = nb_cdf(x, mu[None, :], theta[None, :])
     lo = nb_cdf(x - 1.0, mu[None, :], theta[None, :])
-    v = jax.random.uniform(key, x.shape)
+    # float32-pinned draw: the default dtype widens to float64 on an
+    # x64-enabled host, changing the drawn bits (parity_audit x64:x32)
+    v = jax.random.uniform(key, x.shape, jnp.float32)
     u = jnp.clip(lo + v * (hi - lo), _U_EPS, 1.0 - _U_EPS)
     z = ndtri(u)
     z = (z - jnp.mean(z, axis=0)) / jnp.maximum(jnp.std(z, axis=0), 1e-6)
@@ -89,7 +91,7 @@ def simulate_counts(key: jax.Array, model: CopulaModel, n_cells: int) -> jax.Arr
     reference R/consensusClust.R:763-778): correlated normals -> uniforms ->
     NB quantiles."""
     g = model.mu.shape[0]
-    eps = jax.random.normal(key, (n_cells, g))
+    eps = jax.random.normal(key, (n_cells, g), jnp.float32)
     z = eps @ model.chol.T
     u = jnp.clip(jnorm.cdf(z), _U_EPS, 1.0 - _U_EPS)
     return nb_quantile(u, model.mu[None, :], model.theta[None, :])

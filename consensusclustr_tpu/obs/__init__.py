@@ -31,12 +31,28 @@ The resource layer (ISSUE 6 tentpole, ``obs/resource.py``) adds a background
 gain ``rss_peak_bytes``/``device_peak_bytes`` watermark attrs at close, the
 RunRecord carries the sample series (schema v4), and the Perfetto export
 renders it as ``ph:"C"`` counter tracks under the span lanes.
+
+The numerics layer (ISSUE 8 tentpole, ``obs/fingerprint.py``) observes the
+*values*: device-side array fingerprints (order-independent 64-bit checksum
++ shape/dtype/min/max/mean/nan/inf scalars) stamped at the named pipeline
+checkpoints in ``schema.NUMERIC_CHECKPOINTS`` under the opt-in
+``CCTPU_NUMERICS`` / ``ClusterConfig.numerics`` level (``off``/``watch``/
+``audit``; off is genuinely free). The RunRecord carries the checkpoint
+stream (schema v6) and ``tools/parity_audit.py`` diffs two compute regimes'
+streams, naming the first divergent checkpoint.
 """
 
 from consensusclustr_tpu.obs.export import (
     chrome_trace_events,
     prom_text_from_snapshot,
     write_chrome_trace,
+)
+from consensusclustr_tpu.obs.fingerprint import (
+    NumericsMonitor,
+    array_fingerprint,
+    attach_numerics,
+    numeric_checkpoint,
+    resolve_numerics,
 )
 from consensusclustr_tpu.obs.hist import (
     DEFAULT_BOUNDS,
@@ -78,12 +94,15 @@ __all__ = [
     "Histogram",
     "METRIC_NAMES",
     "MetricsRegistry",
+    "NumericsMonitor",
     "ResourceSampler",
     "RunRecord",
     "SCHEMA_VERSION",
     "SPAN_NAMES",
     "Span",
     "Tracer",
+    "array_fingerprint",
+    "attach_numerics",
     "bucket_quantile",
     "chrome_trace_events",
     "config_fingerprint",
@@ -92,8 +111,10 @@ __all__ = [
     "log_bounds",
     "maybe_span",
     "metrics_of",
+    "numeric_checkpoint",
     "prom_text_from_snapshot",
     "record_device_memory",
+    "resolve_numerics",
     "resource_sampling",
     "tracer_of",
     "write_chrome_trace",

@@ -105,12 +105,41 @@ def counter_track_events(
 
 FLOW_EVENT_NAME = "serve_request"
 FLOW_LANE_NAME = "serve_requests"
+NUMERICS_LANE_NAME = "numerics"
+
+
+def numerics_lane_events(numerics: dict, tid: int) -> List[dict]:
+    """``ph:"i"`` instants for a RunRecord ``numerics`` block (schema v6):
+    one instant per audit checkpoint on a dedicated lane, named by the
+    checkpoint itself (the generic event stream carries the same stamps as
+    ``numeric_fingerprint`` instants on tid 0 — this lane gives them
+    checkpoint names and their own track so a parity investigation can
+    eyeball the stream order)."""
+    out: List[dict] = []
+    for ck in numerics.get("checkpoints") or ():
+        try:
+            ts = _us(float(ck.get("t") or 0.0))
+        except (TypeError, ValueError):
+            continue
+        args = {
+            k: ck[k]
+            for k in ("checksum", "shape", "dtype", "mean", "nan_count",
+                      "inf_count", "span")
+            if ck.get(k) is not None
+        }
+        out.append({
+            "name": str(ck.get("name", "?")), "cat": "numerics", "ph": "i",
+            "ts": ts, "pid": TRACE_PID, "tid": tid, "s": "t",
+            **({"args": args} if args else {}),
+        })
+    return out
 
 
 def chrome_trace_events(
     spans: Iterable[Any],
     events: Iterable[dict] = (),
     resource: Optional[dict] = None,
+    numerics: Optional[dict] = None,
 ) -> List[dict]:
     """Trace-event list for a span tree (+ optional flat event stream and
     resource-sampler counter tracks).
@@ -223,6 +252,8 @@ def chrome_trace_events(
                 **base, "ph": "f", "bp": "e", "id": rid, "ts": a_ts,
                 "tid": a_tid,
             })
+    if numerics and numerics.get("checkpoints"):
+        out.extend(numerics_lane_events(numerics, lane_for(NUMERICS_LANE_NAME)))
     if resource:
         ends = [
             e["ts"] + e.get("dur", 0) for e in out if e.get("ph") in ("X", "i")
@@ -236,10 +267,13 @@ def chrome_trace(
     events: Iterable[dict] = (),
     metadata: Optional[dict] = None,
     resource: Optional[dict] = None,
+    numerics: Optional[dict] = None,
 ) -> dict:
     """The full trace-object form ({"traceEvents": [...]}) Perfetto loads."""
     doc = {
-        "traceEvents": chrome_trace_events(spans, events, resource=resource),
+        "traceEvents": chrome_trace_events(
+            spans, events, resource=resource, numerics=numerics
+        ),
         "displayTimeUnit": "ms",
     }
     if metadata:
@@ -253,11 +287,16 @@ def write_chrome_trace(
     events: Iterable[dict] = (),
     metadata: Optional[dict] = None,
     resource: Optional[dict] = None,
+    numerics: Optional[dict] = None,
 ) -> str:
     """Serialize :func:`chrome_trace` to ``path``; returns the path."""
     with open(path, "w") as f:
         json.dump(
-            chrome_trace(spans, events, metadata=metadata, resource=resource), f
+            chrome_trace(
+                spans, events, metadata=metadata, resource=resource,
+                numerics=numerics,
+            ),
+            f,
         )
     return path
 

@@ -1,0 +1,436 @@
+"""Device-side numeric fingerprints: the values axis of observability.
+
+PRs 1/4/6/7 instrumented time, memory and request lifecycle; this module
+(ISSUE 8 tentpole) observes the *numbers*. The repo runs the same math under
+several compute regimes (dense vs Pallas co-clustering, fused vs looped
+grid, any pipeline depth, x64 vs x32 hosts) whose agreement was pinned only
+in unit tests — at runtime nothing watched the values, so a silent
+divergence on a real workload stayed invisible until labels were wrong.
+
+A fingerprint is a few scalars per call, computed ON DEVICE (jittable, no
+host copy of the array):
+
+  * an order-independent 64-bit checksum of the array's bit pattern —
+    elements are canonicalized to 32-bit lanes, bitcast to uint32, and
+    reduced through two independent wrapping-sum lanes (sum is commutative,
+    so any chunking/streaming of the same elements checksums identically);
+  * shape, dtype, min, max, mean, NaN count, Inf count.
+
+Checkpoints are stamped at the named pipeline stages registered in
+``obs/schema.py::NUMERIC_CHECKPOINTS`` under an opt-in level
+(``CCTPU_NUMERICS`` env / ``ClusterConfig.numerics``):
+
+  * ``off``   (default) — ``numeric_checkpoint`` returns before touching the
+    array (callable payloads are never invoked): zero device dispatches,
+    zero host work.
+  * ``watch`` — NaN/Inf watchdog only: one small reduction per float array;
+    non-finite values increment the ``numerics_nonfinite`` counter, tag the
+    open span and emit a ``numerics_nonfinite`` event.
+  * ``audit`` — full fingerprints: recorded in the tracer-attached
+    ``NumericsMonitor`` (the RunRecord ``numerics`` block, schema v6),
+    emitted as ``numeric_fingerprint`` instant events, and stamped on the
+    enclosing span's ``fingerprints`` attr. ``tools/parity_audit.py`` diffs
+    two regimes' checkpoint streams and names the first divergence.
+
+``CCTPU_NUMERICS_INJECT=bf16:<checkpoint>`` (or ``attach_numerics(...,
+inject=...)``) deliberately downgrades float arrays through bfloat16 at ONE
+named checkpoint before fingerprinting — the self-test proving the parity
+auditor catches a precision downgrade where it was planted.
+
+Import-light like its obs/ siblings: jax loads lazily inside the functions,
+so report tooling importing the package stays backend-free.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from consensusclustr_tpu.obs.tracer import Tracer, metrics_of, tracer_of
+
+# Checkpoint-name constants (tools/check_obs_schema.py validates every
+# ``*_CKPT`` literal here against obs.schema.NUMERIC_CHECKPOINTS, both
+# directions — call sites import these, so a rename cannot silently orphan a
+# checkpoint).
+NORM_CKPT = "norm"                      # post-normalization matrix
+HVG_CKPT = "hvg"                        # HVG-subset matrix feeding PCA
+PCA_CKPT = "pca"                        # PCA embedding
+BOOT_LABELS_CKPT = "boot_labels"        # per-chunk aligned boot labels
+COCLUSTER_CKPT = "cocluster"            # streamed co-cluster count carries
+CONSENSUS_DIST_CKPT = "consensus_dist"  # consensus distance / kNN graph
+LABELS_CKPT = "labels"                  # final labels
+
+# Span-attr constants (validated against obs.schema.NUMERIC_SPAN_ATTRS).
+FINGERPRINT_ATTR = "fingerprints"
+NONFINITE_ATTR = "numerics_nonfinite"
+
+NUMERICS_LEVELS = ("off", "watch", "audit")
+
+# Audit checkpoint records kept per monitor: a long-lived process (serving,
+# huge boot counts) must not grow the RunRecord unboundedly — the counters
+# keep counting past the cap, only the per-checkpoint detail stops.
+NUMERICS_RECORD_CAP = 100_000
+
+_GOLDEN = 0x9E3779B9       # second-lane whitener (golden-ratio constant)
+_MIX_MULT = 2654435761     # Knuth multiplicative-hash constant (mod 2^32)
+
+
+def resolve_numerics(value: Optional[str] = None) -> str:
+    """Resolve the numerics level: explicit ``value`` (ClusterConfig field)
+    beats the ``CCTPU_NUMERICS`` env var beats ``off``. Falsy spellings
+    ("", "0", "none", "false") mean off; anything else unknown raises."""
+    v = value if value is not None else os.environ.get("CCTPU_NUMERICS", "")
+    v = str(v).strip().lower()
+    if v in ("", "0", "none", "false"):
+        return "off"
+    if v not in NUMERICS_LEVELS:
+        raise ValueError(
+            f"numerics level must be one of {NUMERICS_LEVELS}; got {v!r}"
+        )
+    return v
+
+
+def parse_inject(spec: Optional[str]) -> Optional[Tuple[str, str]]:
+    """Parse an injection spec "bf16:<checkpoint>" -> (mode, checkpoint);
+    None/"" -> None. Unknown modes or checkpoints raise loudly — a typo'd
+    injection would otherwise "prove" the auditor by never firing."""
+    if not spec:
+        return None
+    mode, sep, name = str(spec).partition(":")
+    mode = mode.strip().lower()
+    name = name.strip()
+    if not sep or mode != "bf16":
+        raise ValueError(
+            f"inject spec must be 'bf16:<checkpoint>'; got {spec!r}"
+        )
+    from consensusclustr_tpu.obs.schema import NUMERIC_CHECKPOINTS
+
+    if name not in NUMERIC_CHECKPOINTS:
+        raise ValueError(
+            f"inject names unknown checkpoint {name!r} "
+            f"(known: {', '.join(sorted(NUMERIC_CHECKPOINTS))})"
+        )
+    return mode, name
+
+
+# -- the jittable fingerprint -------------------------------------------------
+
+
+def _words_u32(x):
+    """uint32 word view of ``x``'s values. 4-byte dtypes bitcast directly;
+    everything else canonicalizes to a 32-bit lane first (floats -> float32,
+    ints/bools -> int32) so the checksum is well-defined on any input — a
+    *dtype* difference between regimes still surfaces through the recorded
+    ``dtype`` field even when the canonicalized bits agree."""
+    import jax
+    import jax.numpy as jnp
+
+    if x.dtype.itemsize == 4:
+        pass
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    else:
+        x = x.astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+
+
+def fingerprint_scalars(x) -> Dict[str, Any]:
+    """Device-side fingerprint scalars of one array — jittable (traceable
+    inside an enclosing jit; dtype branching is static). Returns a dict of
+    0-d arrays: ``s1``/``s2`` (uint32 checksum lanes), ``min``/``max``/
+    ``mean`` (float32 view), ``nan``/``inf`` (int32 counts; 0 for exact
+    dtypes). The two checksum lanes are independent commutative reductions,
+    so the combined 64-bit checksum is invariant under any element order or
+    chunking of the same multiset of values."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    w = _words_u32(x)
+    s1 = jnp.sum(w, dtype=jnp.uint32)
+    s2 = jnp.sum(
+        (w ^ jnp.uint32(_GOLDEN)) * jnp.uint32(_MIX_MULT), dtype=jnp.uint32
+    )
+    xf = x.astype(jnp.float32)
+    out = {
+        "s1": s1,
+        "s2": s2,
+        "min": jnp.min(xf),
+        "max": jnp.max(xf),
+        "mean": jnp.mean(xf),
+    }
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        out["nan"] = jnp.sum(jnp.isnan(xf), dtype=jnp.int32)
+        out["inf"] = jnp.sum(jnp.isinf(xf), dtype=jnp.int32)
+    else:
+        zero = jnp.int32(0)
+        out["nan"] = zero
+        out["inf"] = zero
+    return out
+
+
+_FP_JIT = None
+
+
+def _fp_jit():
+    """The jitted fingerprint entry (deliberately plain ``jax.jit``, not
+    counting_jit: fingerprints must not perturb the PR 5 dispatch counters
+    they exist to audit alongside)."""
+    global _FP_JIT
+    if _FP_JIT is None:
+        import jax
+
+        _FP_JIT = jax.jit(fingerprint_scalars)
+    return _FP_JIT
+
+
+def _nonfinite_jit():
+    global _NF_JIT
+    if _NF_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def nf(x):
+            return jnp.sum(~jnp.isfinite(x), dtype=jnp.int32)
+
+        _NF_JIT = jax.jit(nf)
+    return _NF_JIT
+
+
+_NF_JIT = None
+
+
+def array_fingerprint(x, jit: bool = True) -> Dict[str, Any]:
+    """Host-side fingerprint dict of one array: ``checksum`` (16-hex-digit,
+    64-bit), ``shape``, ``dtype``, ``min``/``max``/``mean``, ``nan_count``/
+    ``inf_count``. Only the scalar results cross to host. ``jit=False`` runs
+    the same trace eagerly (pinned identical in tests/test_numerics.py)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    if x.size == 0:
+        return {
+            "checksum": f"{0:016x}", "shape": list(x.shape),
+            "dtype": str(x.dtype), "min": None, "max": None, "mean": None,
+            "nan_count": 0, "inf_count": 0,
+        }
+    vals = (_fp_jit() if jit else fingerprint_scalars)(x)
+    s1, s2 = int(vals["s1"]), int(vals["s2"])
+
+    def _finite(v):
+        # NaN/Inf stats serialize as None (strict-JSON hostile otherwise);
+        # the nan_count/inf_count fields carry the signal
+        import math
+
+        f = float(v)
+        return f if math.isfinite(f) else None
+
+    return {
+        "checksum": f"{(s1 << 32) | s2:016x}",
+        "shape": list(x.shape),
+        "dtype": str(x.dtype),
+        "min": _finite(vals["min"]),
+        "max": _finite(vals["max"]),
+        "mean": _finite(vals["mean"]),
+        "nan_count": int(vals["nan"]),
+        "inf_count": int(vals["inf"]),
+    }
+
+
+def merge_fingerprints(parts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One fingerprint for a multi-array checkpoint (e.g. the agree+union
+    co-cluster carries): checksums XOR (still order-independent), stats
+    combine (size-weighted mean), shapes/dtypes list per part."""
+    if len(parts) == 1:
+        return dict(parts[0])
+    csum = 0
+    total = 0
+    w_mean = 0.0
+    mins = [p["min"] for p in parts if p["min"] is not None]
+    maxs = [p["max"] for p in parts if p["max"] is not None]
+    for p in parts:
+        csum ^= int(p["checksum"], 16)
+        n = 1
+        for d in p["shape"]:
+            n *= int(d)
+        if p["mean"] is not None:
+            w_mean += p["mean"] * n
+            total += n
+    return {
+        "checksum": f"{csum:016x}",
+        "shape": [p["shape"] for p in parts],
+        "dtype": [p["dtype"] for p in parts],
+        "min": min(mins) if mins else None,
+        "max": max(maxs) if maxs else None,
+        "mean": (w_mean / total) if total else None,
+        "nan_count": sum(int(p["nan_count"]) for p in parts),
+        "inf_count": sum(int(p["inf_count"]) for p in parts),
+    }
+
+
+# -- the tracer-attached monitor ----------------------------------------------
+
+
+class NumericsMonitor:
+    """Per-run numerics state, attached to a Tracer as ``tracer.numerics``
+    (the same attachment pattern as ``tracer.resource_sampler``):
+    ``checkpoints`` is the ordered audit stream ``tools/parity_audit.py``
+    diffs, ``nonfinite_total`` the watchdog tally. ``summary()`` is the
+    RunRecord ``numerics`` block (schema v6)."""
+
+    def __init__(
+        self,
+        level: str = "audit",
+        inject: Optional[Tuple[str, str]] = None,
+    ) -> None:
+        if level not in ("watch", "audit"):
+            raise ValueError(f"monitor level must be watch|audit; got {level!r}")
+        self.level = level
+        self.inject = inject
+        self.checkpoints: List[dict] = []
+        self.nonfinite_total = 0
+        self.dropped = 0  # audit records past NUMERICS_RECORD_CAP
+
+    def summary(self) -> dict:
+        out: dict = {
+            "level": self.level,
+            "nonfinite": int(self.nonfinite_total),
+            "checkpoints": list(self.checkpoints),
+        }
+        if self.inject is not None:
+            out["inject"] = ":".join(self.inject)
+        if self.dropped:
+            out["dropped"] = int(self.dropped)
+        return out
+
+
+def attach_numerics(
+    tracer: Optional[Tracer],
+    level: Optional[str] = None,
+    inject: Optional[str] = None,
+) -> Optional[NumericsMonitor]:
+    """Attach a NumericsMonitor to ``tracer`` per the resolved level; returns
+    it (None when off or tracer-less — numeric_checkpoint is then a no-op).
+    ``inject`` defaults to the ``CCTPU_NUMERICS_INJECT`` env spec so the
+    parity auditor's planted-downgrade self-test needs no plumbing through
+    the pipeline."""
+    lvl = resolve_numerics(level)
+    if lvl == "off" or tracer is None:
+        return None
+    spec = inject if inject is not None else os.environ.get("CCTPU_NUMERICS_INJECT")
+    mon = NumericsMonitor(lvl, parse_inject(spec))
+    tracer.numerics = mon
+    return mon
+
+
+def _resolve_arrays(arrays) -> List[Any]:
+    """Expand lazy payloads: callables are invoked (only past the level
+    gate — with numerics off they never run), and may return one array or a
+    tuple/list of arrays; None entries drop."""
+    out: List[Any] = []
+    for a in arrays:
+        if a is None:
+            continue
+        if callable(a):
+            a = a()
+        if a is None:
+            continue
+        if isinstance(a, (tuple, list)):
+            out.extend(x for x in a if x is not None)
+        else:
+            out.append(a)
+    return out
+
+
+def _apply_inject(mon: NumericsMonitor, name: str, arrays: List[Any]) -> List[Any]:
+    if mon.inject is None or mon.inject[1] != name:
+        return arrays
+    import jax.numpy as jnp
+
+    out = []
+    for a in arrays:
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            # the deliberate precision downgrade: round-trip through bf16
+            a = a.astype(jnp.bfloat16).astype(a.dtype)
+        out.append(a)
+    return out
+
+
+def numeric_checkpoint(log: Any, name: str, *arrays: Any) -> Optional[dict]:
+    """Stamp checkpoint ``name`` with the fingerprint of ``arrays`` on the
+    log's tracer-attached NumericsMonitor. ``arrays`` entries may be arrays
+    or zero-arg callables returning them (lazy: with numerics off — no
+    monitor attached — this function returns before resolving anything, so
+    the default path pays nothing and dispatches nothing). Returns the audit
+    record (or None in watch/off mode). Never raises: numerics observability
+    must not fail the observed pipeline."""
+    tr = tracer_of(log)
+    mon: Optional[NumericsMonitor] = getattr(tr, "numerics", None) if tr else None
+    if mon is None:
+        return None
+    try:
+        return _checkpoint_impl(tr, mon, name, arrays)
+    except Exception:
+        return None
+
+
+def _checkpoint_impl(
+    tr: Tracer, mon: NumericsMonitor, name: str, arrays
+) -> Optional[dict]:
+    import jax.numpy as jnp
+
+    resolved = _resolve_arrays(arrays)
+    if not resolved:
+        return None
+    mets = metrics_of(tr)
+    sp = tr.current_span()
+
+    if mon.level == "watch":
+        # watchdog only: one small reduction per float array, nothing recorded
+        nonfinite = 0
+        for a in resolved:
+            a = jnp.asarray(a)
+            if jnp.issubdtype(a.dtype, jnp.inexact) and a.size:
+                nonfinite += int(_nonfinite_jit()(a))
+        if nonfinite:
+            _flag_nonfinite(tr, mets, sp, mon, name, nonfinite)
+        return None
+
+    resolved = _apply_inject(mon, name, resolved)
+    fp = merge_fingerprints(
+        [array_fingerprint(a) for a in resolved]
+    )
+    nonfinite = int(fp["nan_count"]) + int(fp["inf_count"])
+    if nonfinite:
+        _flag_nonfinite(tr, mets, sp, mon, name, nonfinite)
+    mets.counter("numerics_checkpoints").inc()
+    rec = {
+        "seq": len(mon.checkpoints) + mon.dropped,
+        "name": name,
+        "t": round(time.monotonic() - tr.epoch, 4),
+        "span": tr.span_path() or None,
+        **fp,
+    }
+    if len(mon.checkpoints) < NUMERICS_RECORD_CAP:
+        mon.checkpoints.append(rec)
+    else:
+        mon.dropped += 1
+    tr.event(
+        "numeric_fingerprint",
+        checkpoint=name,
+        checksum=fp["checksum"],
+        nan_count=fp["nan_count"],
+        inf_count=fp["inf_count"],
+    )
+    if sp is not None:
+        sp.attrs.setdefault(FINGERPRINT_ATTR, {})[name] = fp["checksum"]
+    return rec
+
+
+def _flag_nonfinite(tr, mets, sp, mon, name: str, count: int) -> None:
+    mon.nonfinite_total += count
+    mets.counter("numerics_nonfinite").inc(count)
+    if sp is not None:
+        sp.attrs[NONFINITE_ATTR] = int(sp.attrs.get(NONFINITE_ATTR, 0)) + count
+    tr.event("numerics_nonfinite", checkpoint=name, count=int(count))

@@ -82,6 +82,11 @@ class RunRecord:
     # sample_ms, n_samples, rss/device peak watermarks, [t, rss, dev] rows.
     # None on older records and on runs with sampling off (the default).
     resource: Optional[dict] = None
+    # schema v6: numerics block (obs/fingerprint.py NumericsMonitor summary)
+    # — level, non-finite total, and the ordered checkpoint fingerprint
+    # stream tools/parity_audit.py diffs across regimes. None on older
+    # records and on runs with numerics off (the default).
+    numerics: Optional[dict] = None
 
     @classmethod
     def from_tracer(
@@ -104,6 +109,13 @@ class RunRecord:
                 resource = sampler.series_dict()
             except Exception:
                 resource = None
+        monitor = getattr(tracer, "numerics", None)
+        numerics = None
+        if monitor is not None:
+            try:
+                numerics = monitor.summary()
+            except Exception:
+                numerics = None
         return cls(
             schema=SCHEMA_VERSION,
             backend=backend,
@@ -114,6 +126,7 @@ class RunRecord:
             metrics=reg.snapshot(),
             config=_config_dict(config),
             resource=resource,
+            numerics=numerics,
         )
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -138,6 +151,8 @@ class RunRecord:
         }
         if self.resource is not None:
             d["resource"] = self.resource
+        if self.numerics is not None:
+            d["numerics"] = self.numerics
         return d
 
     def to_json(self) -> str:
@@ -165,6 +180,7 @@ class RunRecord:
                 "wall_s": self.wall_s,
             },
             resource=self.resource,
+            numerics=self.numerics,
         )
 
     @classmethod
@@ -179,6 +195,7 @@ class RunRecord:
             metrics=dict(d.get("metrics", {})),
             config=d.get("config"),
             resource=d.get("resource"),
+            numerics=d.get("numerics"),
         )
 
 

@@ -44,7 +44,19 @@ from __future__ import annotations
 # Perfetto flow events (``ph:"s"``/``ph:"f"``). ``hist_merge_mismatch``
 # counts histogram bucket ladders dropped on merge (previously silent). See
 # docs/quirks.md "Observability schema v4 → v5".
-SCHEMA_VERSION = 5
+# v6 (ISSUE 8): numerics observability — RunRecord gained the optional
+# ``numerics`` block (obs/fingerprint.py NumericsMonitor summary: level,
+# non-finite total, and the ordered checkpoint stream of device-side array
+# fingerprints — order-independent 64-bit checksum + shape/dtype/min/max/
+# mean/nan/inf scalars, stamped at the NUMERIC_CHECKPOINTS below under the
+# opt-in ``CCTPU_NUMERICS`` / ``ClusterConfig.numerics`` level). ``audit``
+# checkpoints ride the event stream as ``numeric_fingerprint`` instants and
+# stamp the enclosing span's ``fingerprints`` attr; the ``watch`` NaN/Inf
+# watchdog increments ``numerics_nonfinite`` and tags the offending span.
+# bench rungs carry ``labels_fingerprint`` and tools/parity_audit.py diffs
+# two regimes' checkpoint streams. See docs/quirks.md
+# "Observability schema v5 → v6".
+SCHEMA_VERSION = 6
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -83,6 +95,9 @@ EVENT_KINDS = frozenset({
     "serve_metrics",   # /metrics + /healthz HTTP exporter came up (port attr)
     "serve_request",   # one accepted submit (req_id + rows attrs) — the
                        # request's flow-event anchor in the Perfetto export
+    # obs/fingerprint.py (ISSUE 8)
+    "numeric_fingerprint",   # one audit-mode checkpoint fingerprint
+    "numerics_nonfinite",    # watchdog: NaN/Inf observed at a checkpoint
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -159,6 +174,9 @@ METRIC_HELP = {
     # registry self-observability (ISSUE 7 satellite): merge drops bucket
     # ladders on a bounds mismatch — previously silent, now counted
     "hist_merge_mismatch": "counter: histogram merges that dropped bucket counts on a bounds-ladder mismatch",
+    # numerics observability (obs/fingerprint.py, ISSUE 8)
+    "numerics_nonfinite": "counter: NaN/Inf values observed at numeric checkpoints (watch/audit watchdog)",
+    "numerics_checkpoints": "counter: numeric checkpoint fingerprints recorded (audit mode)",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -171,4 +189,29 @@ METRIC_NAMES = frozenset(METRIC_HELP)
 RESOURCE_SPAN_ATTRS = frozenset({
     "rss_peak_bytes",     # peak host RSS (bytes) observed while the span ran
     "device_peak_bytes",  # peak device bytes_in_use while the span ran
+})
+
+# Named numeric checkpoints (ISSUE 8): the points in the pipeline where
+# obs/fingerprint.py stamps an array fingerprint under audit mode (and runs
+# the NaN/Inf watchdog under watch). tools/check_obs_schema.py validates the
+# ``*_CKPT`` literals in obs/fingerprint.py against this set, both
+# directions, and that every checkpoint literal tools/parity_audit.py names
+# is registered — a renamed checkpoint is a test failure, not a parity audit
+# that silently stops covering a pipeline stage.
+NUMERIC_CHECKPOINTS = frozenset({
+    "norm",            # post-normalization expression matrix (dense path)
+    "hvg",             # HVG-subset matrix that feeds PCA
+    "pca",             # PCA embedding (the boot grid's input geometry)
+    "boot_labels",     # per-chunk aligned bootstrap label rows
+    "cocluster",       # streamed co-clustering count carries (agree+union)
+    "consensus_dist",  # consensus distance matrix (dense) / kNN (blockwise)
+    "labels",          # final labels (consensus-merged, then assignments)
+})
+
+# Span attrs stamped by obs/fingerprint.py (validated by
+# tools/check_obs_schema.py against the ``*_ATTR`` literals there, both
+# directions — same contract as RESOURCE_SPAN_ATTRS).
+NUMERIC_SPAN_ATTRS = frozenset({
+    "fingerprints",          # audit: {checkpoint: checksum} on the open span
+    "numerics_nonfinite",    # watchdog: NaN/Inf count tagged on the span
 })

@@ -176,6 +176,12 @@ class Tracer:
                     **({} if sp.ok else {"ok": False, "error": sp.error}),
                 })
 
+    def current_span(self) -> Optional[Span]:
+        """This thread's innermost open span (None at top level) — the span
+        numeric checkpoints (obs/fingerprint.py) stamp their attrs on."""
+        stack = self._stack
+        return stack[-1] if stack else None
+
     def span_path(self, leaf: Optional[str] = None) -> str:
         parts = [s.name for s in self._stack]
         if leaf is not None and (not parts or parts[-1] != leaf):

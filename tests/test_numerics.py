@@ -1,0 +1,552 @@
+"""Numerics observability (ISSUE 8): device-side fingerprints, the
+watch/audit levels, regime-parity auditing, and the schema v6 surfaces.
+
+Covers the ISSUE 8 checklist: fingerprint determinism across pipeline depths
+and jit/no-jit, the NaN watchdog counter on a planted NaN, zero divergence
+across every ``tools/parity_audit.py --pair`` preset on the CPU smoke
+workload, injected-bf16 first-divergence localization, the schema v6
+RunRecord round trip + report table, the bench_diff ``--gate parity`` alias,
+and the extended static schema check.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import consensus_cluster
+from consensusclustr_tpu.obs import (
+    RunRecord,
+    SCHEMA_VERSION,
+    Tracer,
+    attach_numerics,
+    global_metrics,
+    numeric_checkpoint,
+)
+from consensusclustr_tpu.obs import fingerprint as fp_mod
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.obs.fingerprint import (
+    BOOT_LABELS_CKPT,
+    LABELS_CKPT,
+    PCA_CKPT,
+    array_fingerprint,
+    merge_fingerprints,
+    parse_inject,
+    resolve_numerics,
+)
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import root_key
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _blob_pca(n=96, d=5, seed=0):
+    r = np.random.default_rng(seed)
+    centers = r.normal(0, 6, size=(3, d))
+    return (
+        centers[r.integers(0, 3, size=n)] + r.normal(0, 1, size=(n, d))
+    ).astype(np.float32)
+
+
+def _smoke_cfg(**kw):
+    base = dict(
+        nboots=4, k_num=(5,), res_range=(0.2, 0.6, 1.0), max_clusters=16,
+        test_significance=False, numerics="audit",
+    )
+    base.update(kw)
+    return ClusterConfig(**base)
+
+
+def _stream(tracer):
+    return [(c["name"], c["checksum"]) for c in tracer.numerics.checkpoints]
+
+
+# -----------------------------------------------------------------------------
+# the fingerprint itself
+# -----------------------------------------------------------------------------
+
+
+class TestArrayFingerprint:
+    def test_order_independent_and_value_sensitive(self):
+        x = np.random.default_rng(0).normal(size=(7, 11)).astype(np.float32)
+        a = array_fingerprint(x)
+        perm = np.random.default_rng(1).permutation(x.reshape(-1)).reshape(x.shape)
+        assert array_fingerprint(perm)["checksum"] == a["checksum"]
+        y = x.copy()
+        y[3, 4] = np.nextafter(y[3, 4], np.inf)  # one-ulp change
+        assert array_fingerprint(y)["checksum"] != a["checksum"]
+
+    def test_jit_and_nojit_identical(self):
+        x = np.random.default_rng(2).normal(size=(13,)).astype(np.float32)
+        assert array_fingerprint(x, jit=True) == array_fingerprint(x, jit=False)
+
+    def test_stats_and_dtype(self):
+        x = np.asarray([[1, -2], [3, 4]], np.int32)
+        fp = array_fingerprint(x)
+        assert fp["shape"] == [2, 2] and fp["dtype"] == "int32"
+        assert fp["min"] == -2.0 and fp["max"] == 4.0 and fp["mean"] == 1.5
+        assert fp["nan_count"] == 0 and fp["inf_count"] == 0
+
+    def test_nonfinite_counted_and_stats_sanitized(self):
+        x = np.asarray([1.0, np.nan, np.inf, -np.inf], np.float32)
+        fp = array_fingerprint(x)
+        assert fp["nan_count"] == 1 and fp["inf_count"] == 2
+        # NaN-poisoned stats serialize as None, never as bare NaN JSON
+        assert fp["min"] is None and fp["mean"] is None
+        json.dumps(fp, allow_nan=False)  # must not raise
+
+    def test_bf16_downgrade_changes_checksum(self):
+        import jax.numpy as jnp
+
+        x = np.random.default_rng(3).normal(size=(32,)).astype(np.float32)
+        down = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(jnp.float32))
+        assert array_fingerprint(down)["checksum"] != array_fingerprint(x)["checksum"]
+
+    def test_empty_array(self):
+        fp = array_fingerprint(np.zeros((0, 4), np.float32))
+        assert fp["checksum"] == "0" * 16 and fp["min"] is None
+
+    def test_merge_xor_and_weighted_mean(self):
+        a = array_fingerprint(np.ones(4, np.float32))
+        b = array_fingerprint(np.full(12, 3.0, np.float32))
+        m = merge_fingerprints([a, b])
+        assert int(m["checksum"], 16) == int(a["checksum"], 16) ^ int(b["checksum"], 16)
+        assert m["mean"] == pytest.approx((1.0 * 4 + 3.0 * 12) / 16)
+        assert merge_fingerprints([a]) == a
+
+    def test_level_resolution(self, monkeypatch):
+        assert resolve_numerics(None) == "off"
+        monkeypatch.setenv("CCTPU_NUMERICS", "watch")
+        assert resolve_numerics(None) == "watch"
+        assert resolve_numerics("audit") == "audit"  # explicit beats env
+        assert resolve_numerics("off") == "off"
+        with pytest.raises(ValueError):
+            resolve_numerics("loud")
+        with pytest.raises(ValueError):
+            ClusterConfig(numerics="loud")
+
+    def test_parse_inject(self):
+        assert parse_inject(None) is None
+        assert parse_inject("bf16:pca") == ("bf16", "pca")
+        with pytest.raises(ValueError):
+            parse_inject("f64:pca")
+        with pytest.raises(ValueError):
+            parse_inject("bf16:nope")
+
+
+# -----------------------------------------------------------------------------
+# checkpoint mechanics: off is free, watch watches, audit records
+# -----------------------------------------------------------------------------
+
+
+class TestCheckpointLevels:
+    def test_off_never_touches_payload(self):
+        tr = Tracer()  # no monitor attached = off
+
+        def boom():
+            raise AssertionError("payload resolved under numerics=off")
+
+        assert numeric_checkpoint(LevelLog(tracer=tr), PCA_CKPT, boom) is None
+        assert not hasattr(tr, "numerics")
+
+    def test_off_adds_zero_device_dispatches(self):
+        """Acceptance: numerics=off leaves the PR 5 device_dispatches counter
+        exactly where a run without the layer would — and audit mode's
+        fingerprints (plain jax.jit) do not perturb it either."""
+        pca = _blob_pca()
+        key = root_key(5)
+
+        def dispatches(cfg):
+            before = global_metrics().counter("device_dispatches").value
+            consensus_cluster(key, pca, cfg, log=LevelLog(tracer=Tracer()))
+            return global_metrics().counter("device_dispatches").value - before
+
+        d_warm = dispatches(_smoke_cfg(numerics="off"))
+        d_off = dispatches(_smoke_cfg(numerics="off"))
+        d_audit = dispatches(_smoke_cfg(numerics="audit"))
+        assert d_off == d_warm  # deterministic workload dispatch count
+        assert d_audit == d_off
+
+    def test_watchdog_counts_planted_nan(self):
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        attach_numerics(tr, "watch")
+        bad = np.ones((4, 4), np.float32)
+        bad[1, 2] = np.nan
+        bad[3, 3] = np.inf
+        with tr.span("pca") as sp:
+            numeric_checkpoint(log, PCA_CKPT, bad)
+        assert tr.metrics.counter("numerics_nonfinite").value == 2
+        assert sp.attrs[fp_mod.NONFINITE_ATTR] == 2
+        assert tr.numerics.nonfinite_total == 2
+        ev = [e for e in tr.events if e["kind"] == "numerics_nonfinite"]
+        assert ev and ev[0]["checkpoint"] == "pca" and ev[0]["count"] == 2
+        # watch records no fingerprints
+        assert tr.numerics.checkpoints == []
+
+    def test_watch_skips_int_arrays(self):
+        tr = Tracer()
+        attach_numerics(tr, "watch")
+        numeric_checkpoint(
+            LevelLog(tracer=tr), LABELS_CKPT, np.arange(8, dtype=np.int32)
+        )
+        assert tr.metrics.counters.get("numerics_nonfinite") is None
+
+    def test_audit_records_span_attr_and_event(self):
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        attach_numerics(tr, "audit")
+        x = np.arange(6, dtype=np.float32)
+        with tr.span("pca") as sp:
+            rec = numeric_checkpoint(log, PCA_CKPT, x)
+        assert rec["name"] == "pca" and rec["span"] == "pca"
+        assert sp.attrs[fp_mod.FINGERPRINT_ATTR]["pca"] == rec["checksum"]
+        ev = [e for e in tr.events if e["kind"] == "numeric_fingerprint"]
+        assert ev and ev[0]["checksum"] == rec["checksum"]
+        assert tr.metrics.counter("numerics_checkpoints").value == 1
+
+    def test_audit_cap_bounds_record(self, monkeypatch):
+        monkeypatch.setattr(fp_mod, "NUMERICS_RECORD_CAP", 3)
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        mon = attach_numerics(tr, "audit")
+        for i in range(5):
+            numeric_checkpoint(log, LABELS_CKPT, np.arange(i + 1))
+        assert len(mon.checkpoints) == 3 and mon.dropped == 2
+        assert mon.summary()["dropped"] == 2
+        assert tr.metrics.counter("numerics_checkpoints").value == 5
+
+    def test_checkpoint_never_raises(self):
+        tr = Tracer()
+        attach_numerics(tr, "audit")
+        # un-fingerprintable payload: swallowed, pipeline unharmed
+        assert numeric_checkpoint(LevelLog(tracer=tr), PCA_CKPT, object()) is None
+
+    def test_inject_hits_only_named_checkpoint(self):
+        x = np.random.default_rng(4).normal(size=(16,)).astype(np.float32)
+        clean = array_fingerprint(x)["checksum"]
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        attach_numerics(tr, "audit", inject="bf16:pca")
+        numeric_checkpoint(log, PCA_CKPT, x)
+        numeric_checkpoint(log, LABELS_CKPT, x)
+        stream = tr.numerics.checkpoints
+        assert stream[0]["checksum"] != clean      # downgraded
+        assert stream[1]["checksum"] == clean      # untouched
+        assert tr.numerics.summary()["inject"] == "bf16:pca"
+
+
+# -----------------------------------------------------------------------------
+# determinism across execution regimes (the consensus layer, direct)
+# -----------------------------------------------------------------------------
+
+
+class TestStreamDeterminism:
+    def test_identical_across_pipeline_depths(self):
+        """ISSUE 8 checklist: fingerprint determinism across pipeline depths —
+        the depth-N window changes WHEN chunks are fetched, never what was
+        computed, so the audit stream must be bit-identical."""
+        pca = _blob_pca(seed=1)
+        key = root_key(9)
+        streams = []
+        for depth in (1, 2, 4):
+            tr = Tracer()
+            consensus_cluster(
+                key, pca, _smoke_cfg(pipeline_depth=depth),
+                log=LevelLog(tracer=tr),
+            )
+            streams.append(_stream(tr))
+        assert streams[0] == streams[1] == streams[2]
+        names = [n for n, _ in streams[0]]
+        assert BOOT_LABELS_CKPT in names and LABELS_CKPT in names
+
+    def test_identical_fused_vs_looped_grid(self, monkeypatch):
+        from consensusclustr_tpu.cluster.engine import resolve_grid_impl
+
+        pca = _blob_pca(seed=2)
+        key = root_key(11)
+        streams = {}
+        for impl in ("fused", "looped"):
+            monkeypatch.setenv("CCTPU_GRID_IMPL", impl)
+            assert resolve_grid_impl() == impl
+            tr = Tracer()
+            consensus_cluster(key, pca, _smoke_cfg(), log=LevelLog(tracer=tr))
+            streams[impl] = _stream(tr)
+        assert streams["fused"] == streams["looped"]
+
+    def test_grid_impl_validation(self, monkeypatch):
+        from consensusclustr_tpu.cluster.engine import resolve_grid_impl
+
+        monkeypatch.setenv("CCTPU_GRID_IMPL", "spiral")
+        with pytest.raises(ValueError):
+            resolve_grid_impl()
+        assert resolve_grid_impl("fused") == "fused"
+
+
+# -----------------------------------------------------------------------------
+# the parity auditor (tools/parity_audit.py)
+# -----------------------------------------------------------------------------
+
+
+class TestParityAudit:
+    @pytest.fixture(scope="class")
+    def audit(self):
+        return _load_tool("parity_audit")
+
+    def _args(self, audit, **kw):
+        import argparse
+
+        base = dict(cells=64, genes=32, boots=3, pcs=3, seed=7)
+        base.update(kw)
+        return argparse.Namespace(**base)
+
+    def test_all_pair_presets_zero_divergence(self, audit):
+        """Acceptance: zero divergent checkpoints across dense:pallas,
+        fused:looped, depth1:depth4 (and x64:x32) on the seeded CPU smoke
+        workload."""
+        args = self._args(audit)
+        for pair in audit.PAIRS:
+            res = audit.audit_pair(pair, args)
+            assert res["ok"], (pair, res["divergence"])
+            assert res["checkpoints"] >= 6  # every stage stamped
+
+    def test_injected_bf16_localizes_pca(self, audit, capsys):
+        """Acceptance: --inject bf16:pca exits 3 naming pca as the FIRST
+        divergent checkpoint (the planted downgrade lands mid-pipeline; the
+        upstream norm/hvg checkpoints must still match)."""
+        rc = audit.main([
+            "--pair", "dense:pallas", "--inject", "bf16:pca",
+            "--cells", "64", "--genes", "32", "--boots", "3", "--pcs", "3",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 3
+        assert "FIRST DIVERGENT CHECKPOINT: pca" in out
+        summary = json.loads(out.strip().splitlines()[-1])
+        d = summary["parity_audit"][0]["divergence"]
+        assert d["checkpoint"] == "pca" and d["field"] == "checksum"
+        # norm and hvg precede pca in the stream: index 2 == nothing before
+        # the injection point diverged
+        assert d["index"] == 2
+
+    def test_unknown_pair_and_bad_inject_exit_1(self, audit, capsys):
+        assert audit.main(["--pair", "bogus"]) == 1
+        assert audit.main(["--pair", "dense:pallas", "--inject", "x:pca"]) == 1
+        capsys.readouterr()
+
+    def test_first_divergence_alignment(self, audit):
+        a = [{"name": "pca", "checksum": "aa", "shape": [4], "dtype": "float32",
+              "nan_count": 0, "inf_count": 0}]
+        same = [dict(a[0])]
+        assert audit.first_divergence(a, same) is None
+        # field mismatch
+        b = [dict(a[0], checksum="bb")]
+        d = audit.first_divergence(a, b)
+        assert d["checkpoint"] == "pca" and d["field"] == "checksum"
+        # structural: different name at same index
+        c = [dict(a[0], name="labels")]
+        assert audit.first_divergence(a, c)["field"] == "name"
+        # length mismatch
+        d = audit.first_divergence(a, a + [dict(a[0], name="labels")])
+        assert d["field"] == "stream_length" and d["checkpoint"] == "labels"
+
+    def test_occurrence_counts_repeated_checkpoints(self, audit):
+        mk = lambda cs: {"name": "boot_labels", "checksum": cs, "shape": [2],
+                         "dtype": "int32", "nan_count": 0, "inf_count": 0}
+        a = [mk("aa"), mk("bb"), mk("cc")]
+        b = [mk("aa"), mk("bb"), mk("dd")]
+        d = audit.first_divergence(a, b)
+        assert d["occurrence"] == 2 and d["index"] == 2
+
+
+# -----------------------------------------------------------------------------
+# schema v6: record round trip, report table, export lane, static check
+# -----------------------------------------------------------------------------
+
+
+class TestSchemaV6:
+    def _audited_record(self):
+        tr = Tracer()
+        log = LevelLog(tracer=tr)
+        attach_numerics(tr, "audit")
+        with tr.span("pca"):
+            numeric_checkpoint(log, PCA_CKPT, np.arange(4, dtype=np.float32))
+        with tr.span("consensus"):
+            bad = np.asarray([1.0, np.nan], np.float32)
+            numeric_checkpoint(log, LABELS_CKPT, bad)
+        return RunRecord.from_tracer(tr)
+
+    def test_record_round_trip(self, tmp_path):
+        assert SCHEMA_VERSION == 6
+        rec = self._audited_record()
+        path = str(tmp_path / "rec.jsonl")
+        rec.write(path)
+        from consensusclustr_tpu.obs import load_records
+
+        back = load_records(path)[-1]
+        assert back.schema == 6
+        assert back.numerics == rec.numerics
+        assert back.numerics["level"] == "audit"
+        assert back.numerics["nonfinite"] == 1
+        assert [c["name"] for c in back.numerics["checkpoints"]] == [
+            "pca", "labels",
+        ]
+
+    def test_registry_entries(self):
+        assert obs_schema.SCHEMA_VERSION == 6
+        assert "pca" in obs_schema.NUMERIC_CHECKPOINTS
+        assert "numeric_fingerprint" in obs_schema.EVENT_KINDS
+        assert "numerics_nonfinite" in obs_schema.METRIC_NAMES
+        assert "fingerprints" in obs_schema.NUMERIC_SPAN_ATTRS
+
+    def test_report_numerics_table(self, tmp_path):
+        report = _load_tool("report")
+        assert 6 in report.KNOWN_SCHEMAS
+        rec = self._audited_record()
+        out = report.render(json.loads(rec.to_json()))
+        assert "== numerics ==" in out
+        assert "pca" in out and "nonfinite values" in out
+        # absent block renders the placeholder, never an error
+        assert "numerics off" in report.numerics({"schema": 5})
+
+    def test_trace_gets_numerics_lane(self, tmp_path):
+        rec = self._audited_record()
+        path = str(tmp_path / "trace.json")
+        rec.to_chrome_trace(path)
+        trace = json.load(open(path))
+        lanes = [
+            e for e in trace["traceEvents"]
+            if e.get("ph") == "M" and (e.get("args") or {}).get("name") == "numerics"
+        ]
+        instants = [
+            e for e in trace["traceEvents"] if e.get("cat") == "numerics"
+        ]
+        assert len(lanes) == 1
+        assert [e["name"] for e in instants] == ["pca", "labels"]
+
+    def test_static_check_clean_and_both_directions(self, tmp_path):
+        check = _load_tool("check_obs_schema")
+        assert os.path.join("tools", "parity_audit.py") in check.SCAN
+        assert check.check(REPO_ROOT) == []
+        # synthetic tree: an unregistered *_CKPT literal and a literal
+        # call-site name must both fail
+        pkg = tmp_path / "consensusclustr_tpu" / "obs"
+        pkg.mkdir(parents=True)
+        (pkg / "fingerprint.py").write_text(
+            'TYPO_CKPT = "tpyo_checkpoint"\n'
+            'BAD_ATTR = "tpyo_attr"\n'
+        )
+        (tmp_path / "consensusclustr_tpu" / "bad.py").write_text(
+            'numeric_checkpoint(log, "tpyo_call")\n'
+        )
+        errors = check.check(str(tmp_path))
+        assert any("tpyo_checkpoint" in e for e in errors)
+        assert any("tpyo_attr" in e for e in errors)
+        assert any("tpyo_call" in e for e in errors)
+        # completeness direction: registry entries unbacked by the synthetic
+        # fingerprint.py are reported
+        assert any(
+            "NUMERIC_CHECKPOINTS entry" in e and "no literal" in e
+            for e in errors
+        )
+
+
+# -----------------------------------------------------------------------------
+# bench labels_fingerprint + bench_diff --gate parity
+# -----------------------------------------------------------------------------
+
+
+def _payload(fp="a" * 16, schema=6, **extra):
+    d = {"metric": "m", "value": 1.0, "unit": "boots/s",
+         "obs_schema": schema, "labels_fingerprint": fp}
+    d.update(extra)
+    return d
+
+
+class TestBenchParityGate:
+    def _run(self, tmp_path, old, new, *extra):
+        po, pn = str(tmp_path / "old.json"), str(tmp_path / "new.json")
+        json.dump(old, open(po, "w"))
+        json.dump(new, open(pn, "w"))
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             po, pn, *extra],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_match_passes_and_prints(self, tmp_path):
+        proc = self._run(tmp_path, _payload(), _payload(), "--gate", "parity")
+        assert proc.returncode == 0, proc.stderr
+        assert "labels_fingerprint: match" in proc.stdout
+
+    def test_drift_exits_3(self, tmp_path):
+        proc = self._run(
+            tmp_path, _payload(fp="a" * 16), _payload(fp="b" * 16),
+            "--gate", "parity",
+        )
+        assert proc.returncode == 3
+        assert "labels_fingerprint" in proc.stderr
+        # without the gate, drift is reported but not fatal
+        soft = self._run(tmp_path, _payload(fp="a" * 16), _payload(fp="b" * 16))
+        assert soft.returncode == 0
+        assert "DRIFT" in soft.stdout
+
+    def test_missing_fingerprint_fails_loudly(self, tmp_path):
+        new = _payload()
+        del new["labels_fingerprint"]
+        proc = self._run(tmp_path, _payload(), new, "--gate", "parity")
+        assert proc.returncode == 1
+        assert "missing" in proc.stderr
+
+    def test_cross_schema_refuses(self, tmp_path):
+        proc = self._run(
+            tmp_path, _payload(schema=5), _payload(schema=6),
+            "--gate", "parity", "--allow-schema-drift",
+        )
+        assert proc.returncode == 1
+        assert "SAME obs_schema" in proc.stderr
+        # and without the gate, the parity line is simply not printed
+        soft = self._run(
+            tmp_path, _payload(schema=5), _payload(schema=6),
+            "--allow-schema-drift",
+        )
+        assert soft.returncode == 0
+        assert "labels_fingerprint" not in soft.stdout
+
+    def test_numeric_gates_still_work_alongside(self, tmp_path):
+        proc = self._run(
+            tmp_path, _payload(value=2.0), _payload(value=1.0),
+            "--gate", "parity", "--gate", "value:0.9",
+        )
+        assert proc.returncode == 3
+        assert "value" in proc.stderr
+
+    def test_bench_helper_fingerprints_string_labels(self):
+        sys.path.insert(0, REPO_ROOT)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO_ROOT)
+        lab = np.asarray(["1", "2", "1", "2_1"], dtype=object)
+        fp = bench._labels_fingerprint(lab)
+        assert isinstance(fp, str) and len(fp) == 16
+        # same partition, same codes -> same fingerprint
+        assert bench._labels_fingerprint(lab.copy()) == fp
+        codes = np.unique(lab, return_inverse=True)[1].astype(np.int32)
+        assert bench._labels_fingerprint(codes) == fp
+        # unsortable garbage degrades to None (the failure rung's value),
+        # never to an exception mid-bench
+        assert bench._labels_fingerprint([object(), object()]) is None

@@ -18,6 +18,15 @@ Usage:
         # latency at the saturation step of the open-loop offered-rate
         # ladder (tools/loadgen.py); "rejections" likewise aliases
         # serve_rejection_rate
+    python tools/bench_diff.py OLD NEW --gate parity          # label parity
+        # gate: exact-match comparison of the per-rung labels_fingerprint
+        # (obs schema v6, obs/fingerprint.py checksum of the rung's label
+        # output) — exits 3 on ANY drift. Not a numeric rung (no MIN_FACTOR,
+        # no direction; the lower-better registry is untouched): labels
+        # either reproduce bit-for-bit or they don't. Only meaningful when
+        # both payloads carry the SAME obs_schema stamp; the gate refuses
+        # (exit 1) otherwise, and a missing fingerprint on either side is a
+        # loud failure, never a silent pass.
 
 Inputs are either the driver wrapper shape committed at the repo root
 ({"n": .., "cmd": .., "rc": .., "tail": .., "parsed": {bench line}}) or a raw
@@ -220,6 +229,32 @@ def diff_table(old: dict, new: dict) -> str:
     return "\n".join(lines)
 
 
+def split_parity_gate(specs: List[str]) -> Tuple[bool, List[str]]:
+    """Pull the non-numeric ``parity`` gate out of the --gate list (it takes
+    no MIN_FACTOR; a stray ``parity:X`` spelling still selects it)."""
+    parity = False
+    rest: List[str] = []
+    for spec in specs:
+        if spec == "parity" or spec.startswith("parity:"):
+            parity = True
+        else:
+            rest.append(spec)
+    return parity, rest
+
+
+def parity_line(
+    old: dict, new: dict, same_schema: bool
+) -> Optional[str]:
+    """Human line comparing labels_fingerprint, or None when either payload
+    predates the stamp (absence is normal on old artifacts) or the schemas
+    differ (fingerprints are only defined comparable within one schema)."""
+    fp_old, fp_new = old.get("labels_fingerprint"), new.get("labels_fingerprint")
+    if not same_schema or fp_old is None or fp_new is None:
+        return None
+    status = "match" if fp_old == fp_new else "DRIFT"
+    return f"labels_fingerprint: {status} (old={fp_old} new={fp_new})"
+
+
 def parse_gates(specs: List[str]) -> List[Tuple[str, float]]:
     gates = []
     for spec in specs:
@@ -299,9 +334,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "compare (--allow-schema-drift to override)"
             )
     print(diff_table(old, new))
+    parity_gated, numeric_gates = split_parity_gate(args.gate)
+    line = parity_line(old, new, same_schema=(s_old == s_new))
+    if line is not None:
+        print(line)
 
     failures = []
-    for rung, min_factor in parse_gates(args.gate):
+    if parity_gated:
+        if s_old != s_new:
+            raise BenchDiffError(
+                1, "--gate parity needs both payloads on the SAME obs_schema "
+                   f"(got {s_old} -> {s_new}): fingerprints are not "
+                   "comparable across schema bumps"
+            )
+        fp_old = old.get("labels_fingerprint")
+        fp_new = new.get("labels_fingerprint")
+        if fp_old is None or fp_new is None:
+            raise BenchDiffError(
+                1, "gated rung 'labels_fingerprint' missing from "
+                   f"{'old' if fp_old is None else 'new'} payload"
+            )
+        if fp_old != fp_new:
+            failures.append(
+                f"labels_fingerprint: drift (old={fp_old} new={fp_new})"
+            )
+    for rung, min_factor in parse_gates(numeric_gates):
         ov, nv = rung_value(old, rung), rung_value(new, rung)
         if ov is None or nv is None:
             raise BenchDiffError(

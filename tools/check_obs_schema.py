@@ -29,6 +29,15 @@ ResourceSampler stamps on closing spans) against
 attr is a test failure, not a silently empty "== memory ==" table in
 tools/report.py.
 
+Since ISSUE 8 the same treatment covers the numerics layer:
+``obs/fingerprint.py``'s ``*_CKPT`` constants <->
+``obs.schema.NUMERIC_CHECKPOINTS`` and its ``*_ATTR`` constants <->
+``obs.schema.NUMERIC_SPAN_ATTRS`` (both directions), literal
+``numeric_checkpoint(log, "...")`` call-site names anywhere in the scanned
+trees, and ``tools/parity_audit.py``'s checkpoint/metric/event literals — a
+renamed checkpoint is a test failure, not a parity audit that silently
+stops covering a pipeline stage.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -55,8 +64,16 @@ MAYBE_SPAN_RE = re.compile(
 METRIC_RE = re.compile(
     r"""\.(counter|gauge|histogram)\(\s*["']([A-Za-z0-9_]+)["']"""
 )
-# obs/resource.py span-attr constants: NAME_ATTR = "literal" at module level
+# obs/resource.py + obs/fingerprint.py span-attr constants:
+# NAME_ATTR = "literal" at module level
 ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# obs/fingerprint.py checkpoint-name constants: NAME_CKPT = "literal"
+CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# literal checkpoint names at numeric_checkpoint(...) call sites (package
+# call sites import the *_CKPT constants, but a literal must still resolve)
+CKPT_CALL_RE = re.compile(
+    r"""numeric_checkpoint\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
+)
 
 # Scanned trees/files, relative to the repo root. Tests are exempt (they
 # exercise the machinery with throwaway names on purpose). The package walk
@@ -72,6 +89,9 @@ SCAN = (
     "bench.py",
     os.path.join("tools", "serve_demo.py"),
     os.path.join("tools", "loadgen.py"),
+    # ISSUE 8: the parity auditor consumes checkpoint streams by name — a
+    # typo'd literal there would audit an always-empty stage
+    os.path.join("tools", "parity_audit.py"),
 )
 
 
@@ -112,42 +132,101 @@ def check_help_registry() -> List[str]:
     return errors
 
 
-def check_resource_attrs(root: str) -> List[str]:
-    """obs/resource.py ``*_ATTR`` literals <-> schema.RESOURCE_SPAN_ATTRS,
-    both directions: every literal registered, every registered attr backed
-    by a literal. Roots without an obs/resource.py (the synthetic trees the
-    tests build) have nothing to validate and pass clean."""
-    rel = os.path.join("consensusclustr_tpu", "obs", "resource.py")
+def _scan_constants(path: str, regex) -> dict:
+    """{literal: (CONST_NAME, lineno)} for module-level constants matching
+    ``regex`` in ``path``."""
+    found: dict = {}
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = regex.match(line)
+            if m:
+                found[m.group(2)] = (m.group(1), lineno)
+    return found
+
+
+def _check_constant_registry(
+    root: str,
+    rel: str,
+    regex,
+    registry_name: str,
+    kind: str,
+    require_complete: bool,
+) -> List[str]:
+    """Module-level constant literals in ``rel`` <-> the ``registry_name``
+    set in obs/schema.py. Every literal must be registered; with
+    ``require_complete`` every registry entry must also be backed by a
+    literal in ``rel`` (the defining module). Roots missing ``rel`` (the
+    synthetic trees the tests build) have nothing to validate and pass
+    clean."""
     path = os.path.join(root, rel)
     if not os.path.isfile(path):
         return []
-    registry = getattr(schema, "RESOURCE_SPAN_ATTRS", None)
+    registry = getattr(schema, registry_name, None)
     if registry is None:
-        return ["obs/schema.py: RESOURCE_SPAN_ATTRS registry is missing"]
+        return [f"obs/schema.py: {registry_name} registry is missing"]
     errors: List[str] = []
-    found = {}
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            m = ATTR_RE.match(line)
-            if m:
-                found[m.group(2)] = (m.group(1), lineno)
+    found = _scan_constants(path, regex)
     for name, (const, lineno) in sorted(found.items()):
         if name not in registry:
             errors.append(
-                f"{rel}:{lineno}: span attr {name!r} ({const}) not in "
-                "obs.schema.RESOURCE_SPAN_ATTRS"
+                f"{rel}:{lineno}: {kind} {name!r} ({const}) not in "
+                f"obs.schema.{registry_name}"
             )
-    for name in sorted(set(registry) - set(found)):
-        errors.append(
-            f"obs/schema.py: RESOURCE_SPAN_ATTRS entry {name!r} has no "
-            f"*_ATTR literal in {rel}"
-        )
+    if require_complete:
+        for name in sorted(set(registry) - set(found)):
+            errors.append(
+                f"obs/schema.py: {registry_name} entry {name!r} has no "
+                f"literal constant in {rel}"
+            )
+    return errors
+
+
+def check_resource_attrs(root: str) -> List[str]:
+    """obs/resource.py ``*_ATTR`` literals <-> schema.RESOURCE_SPAN_ATTRS,
+    both directions: every literal registered, every registered attr backed
+    by a literal."""
+    return _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "obs", "resource.py"),
+        ATTR_RE, "RESOURCE_SPAN_ATTRS", "span attr", require_complete=True,
+    )
+
+
+def check_numeric_registry(root: str) -> List[str]:
+    """ISSUE 8: the numerics registries, both directions.
+
+    * obs/fingerprint.py ``*_CKPT`` literals <-> schema.NUMERIC_CHECKPOINTS
+      (complete: every registered checkpoint must have a defining constant —
+      call sites import these, so an unbacked registry entry means a
+      checkpoint nothing can stamp);
+    * obs/fingerprint.py ``*_ATTR`` literals <-> schema.NUMERIC_SPAN_ATTRS
+      (complete, same contract as the resource attrs);
+    * tools/parity_audit.py ``*_CKPT`` literals must be registered (not
+      complete — the auditor consumes streams, it defines no checkpoints).
+    """
+    fp_rel = os.path.join("consensusclustr_tpu", "obs", "fingerprint.py")
+    audit_rel = os.path.join("tools", "parity_audit.py")
+    errors = _check_constant_registry(
+        root, fp_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, fp_rel, ATTR_RE, "NUMERIC_SPAN_ATTRS", "span attr",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, audit_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
+        require_complete=False,
+    )
     return errors
 
 
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
-    errors: List[str] = check_help_registry() + check_resource_attrs(root)
+    errors: List[str] = (
+        check_help_registry()
+        + check_resource_attrs(root)
+        + check_numeric_registry(root)
+    )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)
         with open(path, encoding="utf-8") as f:
@@ -170,6 +249,14 @@ def check(root: str) -> List[str]:
                         errors.append(
                             f"{rel}:{lineno}: metric name {m.group(2)!r} "
                             f"({m.group(1)}) not in obs.schema.METRIC_NAMES"
+                        )
+                for m in CKPT_CALL_RE.finditer(line):
+                    if m.group(1) not in getattr(
+                        schema, "NUMERIC_CHECKPOINTS", frozenset()
+                    ):
+                        errors.append(
+                            f"{rel}:{lineno}: checkpoint {m.group(1)!r} not "
+                            "in obs.schema.NUMERIC_CHECKPOINTS"
                         )
     return errors
 

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Regime-parity audit: run ONE seeded workload under two compute regimes and
+diff their numeric checkpoint streams (obs/fingerprint.py, schema v6).
+
+The repo computes the same math several ways — dense einsum vs Pallas
+co-clustering, fused vs looped candidate grid, any pipeline depth, x64 vs
+x32 hosts — and pins their agreement in unit tests only. This tool is the
+runtime counterpart: both regimes run ``consensus_clust`` on the same seeded
+synthetic workload under ``numerics=audit``, and the two ordered fingerprint
+streams are compared checkpoint by checkpoint. The FIRST divergent
+checkpoint is named (exit 3), which localizes a numeric regression to a
+pipeline stage instead of "the labels came out different".
+
+Usage:
+    python tools/parity_audit.py --pair dense:pallas
+    python tools/parity_audit.py --pair fused:looped --pair depth1:depth4
+    python tools/parity_audit.py                      # all presets
+    python tools/parity_audit.py --pair dense:pallas --inject bf16:pca
+        # ^ self-test: deliberately downgrade the pca checkpoint through
+        #   bfloat16 in the SECOND regime — the audit must exit 3 naming
+        #   "pca", proving it catches a planted precision downgrade
+    python tools/parity_audit.py --json audit.json    # machine summary
+
+Pair presets (regime A : regime B):
+
+  dense:pallas   use_pallas=False vs True — on TPU this is the einsum oracle
+                 vs the Mosaic kernel; on CPU both resolve to einsum (the
+                 kernel dispatch is TPU-only), so the pair degenerates to a
+                 self-check there (tools/tpu_pallas_parity.py wraps this
+                 pair for the hardware run).
+  fused:looped   CCTPU_GRID_IMPL=fused vs looped — the vmapped-k production
+                 grid vs the per-k loop parity oracle (cluster/engine.py).
+  depth1:depth4  pipeline_depth 1 vs 4 — strict serial dispatch vs four
+                 boot chunks in flight (parallel/pipelined.py's
+                 bit-identical-at-any-depth contract, now value-audited).
+  x64:x32        jax_enable_x64 on vs off — the pipeline pins float32/int32
+                 everywhere explicitly, so host-promotion differences must
+                 not reach any checkpoint.
+
+Exit codes: 0 all pairs parity-clean; 1 usage/malformed; 3 divergence (the
+first divergent checkpoint is printed per pair and carried in the JSON
+summary line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Regime spec keys: plain keys are ClusterConfig overrides; "env" is an env
+# patch for the run; "x64" toggles jax_enable_x64 for the run.
+PAIRS: Dict[str, Tuple[dict, dict]] = {
+    "dense:pallas": ({"use_pallas": False}, {"use_pallas": True}),
+    "fused:looped": (
+        {"env": {"CCTPU_GRID_IMPL": "fused"}},
+        {"env": {"CCTPU_GRID_IMPL": "looped"}},
+    ),
+    "depth1:depth4": ({"pipeline_depth": 1}, {"pipeline_depth": 4}),
+    "x64:x32": ({"x64": True}, {"x64": False}),
+}
+
+# Fingerprint fields whose mismatch counts as divergence. Stats (min/max/
+# mean) derive from the same values as the checksum — comparing the checksum
+# plus structure keeps the diff exact without float-repr noise.
+_COMPARE_FIELDS = ("checksum", "shape", "dtype", "nan_count", "inf_count")
+
+
+@contextlib.contextmanager
+def _env_patch(patch: Dict[str, Optional[str]]):
+    """Temporarily set/unset env vars; always restores."""
+    old = {k: os.environ.get(k) for k in patch}
+    try:
+        for k, v in patch.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@contextlib.contextmanager
+def _x64_flag(enabled: Optional[bool]):
+    if enabled is None:
+        yield
+        return
+    import jax
+
+    before = bool(jax.config.jax_enable_x64)
+    jax.config.update("jax_enable_x64", bool(enabled))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", before)
+
+
+def smoke_counts(cells: int, genes: int, seed: int):
+    """The seeded CPU-smoke workload both regimes consume: a small planted
+    NB mixture (utils/synth.py — same generator as the pbmc3k bench
+    fixture, shrunk)."""
+    from consensusclustr_tpu.utils.synth import nb_mixture_counts
+
+    counts, _ = nb_mixture_counts(
+        n_cells=cells, n_genes=genes, n_populations=3, seed=seed
+    )
+    return counts
+
+
+def run_regime(
+    regime: dict, counts, args, inject: Optional[str] = None
+) -> List[dict]:
+    """One audited ``consensus_clust`` run under ``regime``; returns its
+    ordered checkpoint stream."""
+    from consensusclustr_tpu.api import consensus_clust
+    from consensusclustr_tpu.config import ClusterConfig
+
+    overrides = {k: v for k, v in regime.items() if k not in ("env", "x64")}
+    env = dict(regime.get("env") or {})
+    if inject:
+        env["CCTPU_NUMERICS_INJECT"] = inject
+    cfg = ClusterConfig(
+        nboots=args.boots,
+        pc_num=args.pcs,
+        k_num=(5,),
+        res_range=(0.1, 0.5, 1.0),
+        test_significance=False,
+        iterate=False,
+        numerics="audit",
+        seed=args.seed,
+        **overrides,
+    )
+    with _env_patch(env), _x64_flag(regime.get("x64")):
+        res = consensus_clust(counts, config=cfg)
+    numerics = (res.run_record.numerics or {}) if res.run_record else {}
+    return list(numerics.get("checkpoints") or [])
+
+
+def first_divergence(a: List[dict], b: List[dict]) -> Optional[dict]:
+    """The first checkpoint where the two streams disagree, or None.
+
+    Streams are compared in order; per entry the checkpoint NAME must match
+    (a structural difference — one regime stamping a stage the other never
+    reaches — is itself a divergence at that point), then the fingerprint
+    fields. ``occurrence`` counts how many same-named checkpoints preceded
+    the divergent one (chunked stages stamp per chunk)."""
+    seen: Dict[str, int] = {}
+    for i, (ca, cb) in enumerate(zip(a, b)):
+        name = ca.get("name")
+        occurrence = seen.get(str(name), 0)
+        seen[str(name)] = occurrence + 1
+        if name != cb.get("name"):
+            return {
+                "index": i, "checkpoint": name, "occurrence": occurrence,
+                "field": "name", "a": name, "b": cb.get("name"),
+            }
+        for field in _COMPARE_FIELDS:
+            if ca.get(field) != cb.get(field):
+                return {
+                    "index": i, "checkpoint": name, "occurrence": occurrence,
+                    "field": field, "a": ca.get(field), "b": cb.get(field),
+                }
+    if len(a) != len(b):
+        longer = a if len(a) > len(b) else b
+        i = min(len(a), len(b))
+        return {
+            "index": i, "checkpoint": longer[i].get("name"), "occurrence": None,
+            "field": "stream_length", "a": len(a), "b": len(b),
+        }
+    return None
+
+
+def audit_pair(pair: str, args, inject: Optional[str] = None) -> dict:
+    """Run both regimes of ``pair`` on the shared workload and diff."""
+    spec_a, spec_b = PAIRS[pair]
+    counts = smoke_counts(args.cells, args.genes, args.seed)
+    stream_a = run_regime(spec_a, counts, args)
+    # injection (when asked) lands in the SECOND regime only — the planted
+    # downgrade the audit must localize
+    stream_b = run_regime(spec_b, counts, args, inject=inject)
+    div = first_divergence(stream_a, stream_b)
+    return {
+        "pair": pair,
+        "checkpoints": len(stream_a),
+        "divergence": div,
+        "ok": div is None,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--pair", action="append", default=[], metavar="A:B",
+        help=f"regime pair preset (repeatable; default: all of "
+             f"{', '.join(PAIRS)})",
+    )
+    ap.add_argument("--cells", type=int, default=96,
+                    help="workload cells (default 96 — CPU smoke)")
+    ap.add_argument("--genes", type=int, default=48, help="workload genes")
+    ap.add_argument("--boots", type=int, default=4, help="bootstraps")
+    ap.add_argument("--pcs", type=int, default=3, help="pc_num")
+    ap.add_argument("--seed", type=int, default=7, help="workload + run seed")
+    ap.add_argument(
+        "--inject", metavar="bf16:CKPT", default=None,
+        help="plant a bfloat16 downgrade at CKPT in the second regime; the "
+             "audit must then exit 3 naming CKPT (auditor self-test)",
+    )
+    ap.add_argument("--json", metavar="OUT", default=None,
+                    help="also write the machine summary to this path")
+    args = ap.parse_args(argv)
+
+    pairs = args.pair or list(PAIRS)
+    for p in pairs:
+        if p not in PAIRS:
+            print(
+                f"parity_audit: unknown pair {p!r} (known: "
+                f"{', '.join(PAIRS)})",
+                file=sys.stderr,
+            )
+            return 1
+    if args.inject is not None:
+        from consensusclustr_tpu.obs.fingerprint import parse_inject
+
+        try:
+            parse_inject(args.inject)
+        except ValueError as e:
+            print(f"parity_audit: {e}", file=sys.stderr)
+            return 1
+
+    results = []
+    for pair in pairs:
+        res = audit_pair(pair, args, inject=args.inject)
+        results.append(res)
+        if res["ok"]:
+            print(
+                f"{pair}: parity ok across {res['checkpoints']} checkpoints"
+            )
+        else:
+            d = res["divergence"]
+            occ = (
+                f" (occurrence {d['occurrence']})"
+                if d.get("occurrence") else ""
+            )
+            print(
+                f"{pair}: FIRST DIVERGENT CHECKPOINT: {d['checkpoint']}"
+                f"{occ} — {d['field']}: {d['a']!r} != {d['b']!r} "
+                f"(stream index {d['index']})"
+            )
+    ok = all(r["ok"] for r in results)
+    summary = {
+        "parity_audit": results,
+        "workload": {
+            "cells": args.cells, "genes": args.genes, "boots": args.boots,
+            "pcs": args.pcs, "seed": args.seed,
+        },
+        "inject": args.inject,
+        "ok": ok,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -305,6 +305,52 @@ def memory(record: dict) -> str:
     return "\n".join(out)
 
 
+def numerics(record: dict) -> str:
+    """Numeric checkpoint table (obs schema >= 6): the audit-mode fingerprint
+    stream aggregated per checkpoint name — occurrence count, whether every
+    occurrence carried one checksum or several (chunked stages legitimately
+    vary per chunk), and the NaN/Inf tallies — plus the watchdog total.
+    Records written with numerics off (the default) or by older schemas
+    render the placeholder line; every key access is guarded (same contract
+    as the serving/dispatch/memory tables)."""
+    num = record.get("numerics") or {}
+    checkpoints = num.get("checkpoints") or []
+    if not num:
+        return "(no numerics — CCTPU_NUMERICS / ClusterConfig.numerics off)"
+    lines = [f"{'level':<28} {num.get('level', '?')}"]
+    lines.append(f"{'nonfinite values':<28} {num.get('nonfinite', 0)}")
+    if num.get("inject"):
+        lines.append(f"{'injected downgrade':<28} {num['inject']}")
+    if num.get("dropped"):
+        lines.append(f"{'checkpoints dropped (cap)':<28} {num['dropped']}")
+    if not checkpoints:
+        return "\n".join(lines)
+    order: List[str] = []
+    by_name: dict = {}
+    for ck in checkpoints:
+        name = str(ck.get("name", "?"))
+        if name not in by_name:
+            order.append(name)
+            by_name[name] = {"n": 0, "sums": [], "nan": 0, "inf": 0}
+        agg = by_name[name]
+        agg["n"] += 1
+        agg["sums"].append(ck.get("checksum"))
+        agg["nan"] += int(ck.get("nan_count") or 0)
+        agg["inf"] += int(ck.get("inf_count") or 0)
+    lines.append(
+        f"{'checkpoint':<16} {'n':>4} {'checksum':<18} {'nan':>6} {'inf':>6}"
+    )
+    for name in order:
+        agg = by_name[name]
+        uniq = sorted(set(filter(None, agg["sums"])))
+        csum = uniq[0] if len(uniq) == 1 else f"({len(uniq)} distinct)"
+        lines.append(
+            f"{name:<16} {agg['n']:>4} {csum:<18} {agg['nan']:>6} "
+            f"{agg['inf']:>6}"
+        )
+    return "\n".join(lines)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -342,6 +388,7 @@ def render(record: dict) -> str:
         "", "== serving ==", serving(record),
         "", "== dispatch ==", dispatch(record),
         "", "== memory ==", memory(record),
+        "", "== numerics ==", numerics(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]
@@ -383,6 +430,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "wall_s": rec.get("wall_s"),
             },
             resource=rec.get("resource"),
+            numerics=rec.get("numerics"),
         )
         out.append(f"trace -> {args.trace} (open in ui.perfetto.dev)")
     print("\n".join(out))

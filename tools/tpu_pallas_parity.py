@@ -1,30 +1,41 @@
-"""Hardware Pallas parity check: the ONE artifact that proves the Mosaic
-kernel compiles and runs on a real TPU (VERDICT r3: interpret-mode parity
-only is not hardware evidence).
+"""Hardware Pallas parity check — thin wrapper over tools/parity_audit.py.
 
-Runs pallas_coclustering_distance vs the einsum oracle on the real default
-backend for three shapes (robust, granular-ish, tall-n), fetches results to
-host (the tunnel's block_until_ready is unreliable), prints per-shape timings
-and max-abs diffs, then ONE JSON line:
+Historically this tool ran its own ad-hoc kernel-vs-einsum comparison; since
+ISSUE 8 there is ONE parity entry point (``tools/parity_audit.py``) that
+audits the full pipeline's numeric checkpoint stream across regimes, and
+this script just runs its ``dense:pallas`` pair on the real TPU backend —
+the one artifact that proves the Mosaic kernel compiles, runs, and agrees
+with the einsum oracle on hardware (VERDICT r3: interpret-mode parity only
+is not hardware evidence). On the way it still exercises exactly the
+dispatch the old tool did (``use_pallas=True`` routes the co-clustering
+distance through ops/pallas_cocluster.py on TPU), but the comparison now
+covers every checkpoint, not just the distance matrix.
 
-    {"pallas_hardware_parity": {...}, "backend": "...", "ok": true}
-
-Keeps every single device call well under the tunnel's ~2-min watchdog:
-the largest shape here compiles a small grid (n<=2048 -> 8x8 tiles).
+CLI surface unchanged: no arguments, prints ``backend=...`` then ONE JSON
+line with a ``pallas_hardware_parity`` block and ``ok``; exit 0 = parity,
+1 = not on TPU, 2 = divergence.
 """
 
 from __future__ import annotations
 
+import argparse
+import importlib.util
 import json
+import os
 import sys
-import time
 
-import numpy as np
+
+def _load_parity_audit():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "parity_audit.py")
+    spec = importlib.util.spec_from_file_location("_cctpu_parity_audit", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main() -> int:
     import jax
-    import jax.numpy as jnp
 
     backend = jax.default_backend()
     print(f"backend={backend}", flush=True)
@@ -33,72 +44,31 @@ def main() -> int:
                           "error": "not on tpu; parity would be meaningless"}))
         return 1
 
-    from consensusclustr_tpu.consensus.cocluster import (
-        _einsum_coclustering_distance,
-    )
-    from consensusclustr_tpu.ops.pallas_cocluster import (
-        pallas_coclustering_distance,
-    )
-
-    rng = np.random.default_rng(0)
-    shapes = {
-        # (B, n, n_clusters): robust default, granular-ish B, taller n,
-        # then the bench workload shape (10k cells) — kept last so the small
-        # grids bank even if the big one trips the tunnel watchdog
-        "robust_100x1024": (100, 1024, 24),
-        "granular_720x512": (720, 512, 48),
-        "tall_32x2048": (32, 2048, 12),
-        "bench_24x10000": (24, 10_000, 64),
+    pa = _load_parity_audit()
+    # hardware shapes: big enough that the Pallas kernel genuinely tiles
+    # (n > one 8x128 tile), small enough to stay far under the serving
+    # tunnel's ~2-min per-call watchdog
+    args = argparse.Namespace(cells=1024, genes=64, boots=8, pcs=8, seed=0)
+    res = pa.audit_pair("dense:pallas", args)
+    out = {
+        "pallas_hardware_parity": res,
+        "backend": backend,
+        "ok": bool(res["ok"]),
     }
-    out: dict = {}
-    ok = True
-    # mxu first (the current default), vpu second (the r5 A/B baseline,
-    # hardware-proven 2026-07-31) — each timed cold+warm vs the einsum
-    # oracle so every healthy window banks a before/after pair on chip.
-    variants = ("mxu", "vpu")
-    for name, (b, n, c) in shapes.items():
-        lab = rng.integers(-1, c, size=(b, n)).astype(np.int32)
-        lab_dev = jnp.asarray(lab)
-        rec: dict = {}
-
-        t0 = time.time()
-        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, c))
-        rec["einsum_cold_s"] = round(time.time() - t0, 3)
-        t0 = time.time()
-        d_oracle = np.asarray(_einsum_coclustering_distance(lab_dev, c))
-        rec["einsum_warm_s"] = round(time.time() - t0, 3)
-
-        for variant in variants:
-            t0 = time.time()
-            d_pallas = np.asarray(  # host fetch = real sync
-                pallas_coclustering_distance(lab_dev, c, variant=variant)
-            )
-            rec[f"{variant}_cold_s"] = round(time.time() - t0, 3)
-            t0 = time.time()
-            d_pallas = np.asarray(
-                pallas_coclustering_distance(lab_dev, c, variant=variant)
-            )
-            rec[f"{variant}_warm_s"] = round(time.time() - t0, 3)
-            diff = float(np.max(np.abs(d_pallas - d_oracle)))
-            rec[f"{variant}_max_abs_diff"] = diff
-            ok = ok and diff < 1e-5
-
-        out[name] = rec
+    if res["ok"]:
         print(
-            f"{name}: "
-            + " ".join(
-                f"{v}: diff={rec[f'{v}_max_abs_diff']:.2e} "
-                f"{rec[f'{v}_warm_s']*1e3:.1f} ms"
-                for v in variants
-            )
-            + f" einsum {rec['einsum_warm_s']*1e3:.1f} ms",
+            f"dense:pallas parity ok across {res['checkpoints']} checkpoints",
             flush=True,
         )
-
-    print(json.dumps(
-        {"pallas_hardware_parity": out, "backend": backend, "ok": ok}
-    ), flush=True)
-    return 0 if ok else 2
+    else:
+        d = res["divergence"]
+        print(
+            f"FIRST DIVERGENT CHECKPOINT: {d['checkpoint']} — "
+            f"{d['field']}: {d['a']!r} != {d['b']!r}",
+            flush=True,
+        )
+    print(json.dumps(out), flush=True)
+    return 0 if res["ok"] else 2
 
 
 if __name__ == "__main__":

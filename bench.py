@@ -44,6 +44,15 @@ rss:...`` turns peak_rss_mb into the O1 peak-memory regression gate.
 BENCH_BALLAST_MB pins a deliberate host allocation for the run — the knob
 that proves the gate can see an O1-scale regression.
 
+Sparse-consensus accounting (ISSUE 9): every rung also carries a
+``sparse_consensus`` block — the kNN-restricted consensus regime measured at
+>= 8x the default rung's cells (BENCH_SPARSE_CELLS / BENCH_SPARSE_BOOTS
+override), reporting boots/s, the consensus phase's own RSS watermark
+(``cocluster_rss_peak_mb``, the O1 sub-quadratic gate surface:
+``tools/bench_diff.py --gate sparse_rss:...``), the exact carry footprint
+(``carry_mb`` = n*m*8 bytes vs ``dense_equiv_mb`` = n*n*8), and the rung's
+consensus-label fingerprint.
+
 Numerics accounting (obs schema v6, ISSUE 8): every rung also carries
 ``labels_fingerprint`` — the obs/fingerprint.py order-independent 64-bit
 checksum of the rung's label output (final assignments for pbmc3k, consensus
@@ -186,6 +195,104 @@ _SERVING_SLO_ZERO = {
     "serving_p99_ms": 0.0,
     "serve_rejection_rate": 0.0,
 }
+
+# The sparse-consensus rung's zero shape (ISSUE 9) — emitted verbatim on the
+# failure rung so BENCH_*.json lines stay key-comparable across rounds.
+_SPARSE_CONSENSUS_ZERO = {
+    "cells": 0,
+    "boots": 0,
+    "candidate_m": 0,
+    "pairs_ratio": 0.0,
+    "boots_per_sec": 0.0,
+    "wall_s": 0.0,
+    "n_clusters": 0,
+    "peak_rss_mb": 0.0,
+    "cocluster_rss_peak_mb": 0.0,
+    "carry_mb": 0.0,
+    "dense_equiv_mb": 0.0,
+    "labels_fingerprint": None,
+}
+
+
+def _sparse_consensus_rung() -> dict:
+    """kNN-restricted consensus at scale (ISSUE 9): the sparse_knn regime on
+    a synthetic mixture at >= 8x the default rung's cell count (the largest
+    shape the 240 s probe budget tolerates on CPU smoke; BENCH_SPARSE_CELLS
+    / BENCH_SPARSE_BOOTS override). Reports boots/s, the rung's own
+    peak-RSS watermarks — ``cocluster_rss_peak_mb`` is the consensus
+    phase's span watermark, the O1 sub-quadratic gate surface — plus the
+    EXACT accumulator footprint (``carry_mb`` = n*m*8 bytes) against the
+    dense equivalent (``dense_equiv_mb`` = n*n*8 bytes), and the rung's
+    consensus-label fingerprint. Never raises: any failure returns the zero
+    shape with an error note."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from consensusclustr_tpu.config import ClusterConfig
+        from consensusclustr_tpu.consensus.pipeline import consensus_cluster
+        from consensusclustr_tpu.obs import Tracer
+        from consensusclustr_tpu.utils.log import LevelLog
+        from consensusclustr_tpu.utils.rng import root_key
+
+        backend = jax.default_backend()
+        on_accel = backend not in ("cpu",)
+        base = int(os.environ.get("BENCH_CELLS", 10_000 if on_accel else 512))
+        n = int(os.environ.get("BENCH_SPARSE_CELLS", 8 * base))
+        nboots = int(os.environ.get("BENCH_SPARSE_BOOTS", 24 if on_accel else 4))
+        d = int(os.environ.get("BENCH_PCS", 20))
+
+        rng = np.random.default_rng(0)
+        centers = rng.normal(0.0, 6.0, size=(8, d))
+        pca = (
+            centers[rng.integers(0, 8, size=n)] + rng.normal(0, 1.0, size=(n, d))
+        ).astype(np.float32)
+
+        cfg = ClusterConfig(
+            nboots=nboots, consensus_regime="sparse_knn",
+            res_range=(0.1, 0.5, 1.0), k_num=(10, 15), max_clusters=64,
+            resource_sample_ms=25,
+        )
+        tracer = Tracer()
+        t0 = time.perf_counter()
+        res = consensus_cluster(
+            root_key(123), jnp.asarray(pca), cfg, log=LevelLog(tracer=tracer)
+        )
+        dt = time.perf_counter() - t0
+
+        cocluster_rss = rss_peak = 0.0
+        m = pairs_ratio = None
+        for root in tracer.roots:
+            for _, sp in root.walk():
+                attrs = sp.attrs or {}
+                if "rss_peak_bytes" in attrs:
+                    rss_peak = max(rss_peak, float(attrs["rss_peak_bytes"]))
+                if sp.name == "cocluster":
+                    m = attrs.get("candidate_m", m)
+                    pairs_ratio = attrs.get("pairs_ratio", pairs_ratio)
+                    if "rss_peak_bytes" in attrs:
+                        cocluster_rss = float(attrs["rss_peak_bytes"])
+        m = int(m if m is not None else (res.sparse.m if res.sparse else 0))
+        return {
+            "cells": n,
+            "boots": nboots,
+            "candidate_m": m,
+            "pairs_ratio": float(
+                pairs_ratio if pairs_ratio is not None else m / max(n, 1)
+            ),
+            "boots_per_sec": round(nboots / dt, 3),
+            "wall_s": round(dt, 3),
+            "n_clusters": int(res.n_clusters),
+            "peak_rss_mb": round(rss_peak / 1e6, 1),
+            "cocluster_rss_peak_mb": round(cocluster_rss / 1e6, 1),
+            # deterministic memory model: the restricted carries are exactly
+            # 2 x [n, m] f32; the dense regime's would be 2 x [n, n]
+            "carry_mb": round(n * m * 8 / 1e6, 2),
+            "dense_equiv_mb": round(float(n) * n * 8 / 1e6, 2),
+            "labels_fingerprint": _labels_fingerprint(res.labels),
+        }
+    except Exception as e:
+        return dict(_SPARSE_CONSENSUS_ZERO, error=str(e)[:200])
 
 
 def _load_loadgen():
@@ -452,6 +559,7 @@ def _run_pbmc3k() -> dict:
         ),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
+        "sparse_consensus": _sparse_consensus_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -518,6 +626,7 @@ def _run_granular() -> dict:
         "overlap_ratio": _overlap_ratio(tracer.roots),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
+        "sparse_consensus": _sparse_consensus_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -649,6 +758,7 @@ def _run() -> dict:
         "overlap_ratio": _overlap_ratio(tracer.roots),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
+        "sparse_consensus": _sparse_consensus_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -849,6 +959,7 @@ def main() -> None:
             "serving": dict(_SERVING_ZERO),
             **{k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()},
+            "sparse_consensus": dict(_SPARSE_CONSENSUS_ZERO),
             "probe_s": probe_s,
             **_dispatch_delta(dispatch0, _dispatch_counters()),
             **_resource_rung(sampler),

@@ -915,6 +915,26 @@ def _consensus_clust_run(
         if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
             if cons.jaccard_dist is not None:
                 dend = determine_hierarchy(cons.jaccard_dist, labels)
+            elif getattr(cons, "sparse", None) is not None:
+                # sparse_knn regime (ISSUE 9): the restricted counts are in
+                # hand, so the cluster-pair dendrogram distances cost one
+                # O(n·m) segment-sum — no [n, n] pass, no tile re-stream
+                from consensusclustr_tpu.consensus.merge import (
+                    restricted_cluster_distance,
+                )
+                from consensusclustr_tpu.hierarchy.dendro import (
+                    _sorted_unique,
+                    dendrogram_from_cluster_distance,
+                )
+
+                uniq = _sorted_unique(np.asarray(labels))
+                code_of = {u: i for i, u in enumerate(uniq)}
+                codes = np.asarray([code_of[l] for l in labels], np.int32)
+                cmat = restricted_cluster_distance(
+                    cons.sparse.agree, cons.sparse.union,
+                    cons.sparse.cand_idx, codes, len(uniq),
+                )
+                dend = dendrogram_from_cluster_distance(cmat, uniq)
             elif cons.boot_labels is not None:
                 # blockwise regime: the cell-cell matrix never existed; stream
                 # the cluster-pair mean co-clustering distances instead (:621)
@@ -957,7 +977,32 @@ def _consensus_clust_run(
 
             leaf = leaf_label_table(labels)
             stability = np.ones(len(leaf), np.float32)
-            if cons is not None and cons.boot_labels is not None and len(leaf) > 1:
+            stability_source = None
+            if (
+                cons is not None
+                and getattr(cons, "sparse", None) is not None
+                and len(leaf) > 1
+            ):
+                # sparse_knn regime: the stability diagonal comes straight
+                # from the restricted counts (mean within-cluster candidate
+                # -pair co-clustering rate) — O(n·m), no per-boot Rand pass
+                from consensusclustr_tpu.consensus.merge import (
+                    stability_from_restricted_counts,
+                )
+
+                code_of = {s: i for i, s in enumerate(leaf)}
+                codes = np.asarray(
+                    [code_of[str(l)] for l in labels], np.int32
+                )
+                stability = np.clip(
+                    stability_from_restricted_counts(
+                        cons.sparse.agree, cons.sparse.union,
+                        cons.sparse.cand_idx, codes, len(leaf),
+                    ),
+                    0.0, 1.0,
+                ).astype(np.float32)
+                stability_source = "cocluster_restricted"
+            elif cons is not None and cons.boot_labels is not None and len(leaf) > 1:
                 from consensusclustr_tpu.consensus.merge import stability_matrix
 
                 code_of = {s: i for i, s in enumerate(leaf)}
@@ -974,7 +1019,11 @@ def _consensus_clust_run(
                 stability = np.clip(
                     np.diagonal(sm)[: len(leaf)], 0.0, 1.0
                 ).astype(np.float32)
-            fit = ReferenceFit(stability=stability, **fit_capture)
+                stability_source = "boot_rand"
+            fit = ReferenceFit(
+                stability=stability, stability_source=stability_source,
+                **fit_capture,
+            )
 
     # numerics checkpoint: the run's final assignments (string lineage
     # labels fingerprinted through their sorted-unique integer codes — two

@@ -158,6 +158,24 @@ def knn_cross(
     return idx.astype(jnp.int32), jnp.sqrt(jnp.maximum(-neg, 0.0))
 
 
+def knn_candidates(
+    x: jax.Array, m: int, block: int = KNN_BLOCK, compute_dtype: str = "float32"
+) -> jax.Array:
+    """[n, m] int32 candidate-neighbour sets in PC space, self excluded —
+    the pair restriction of the sparse consensus regime (ISSUE 9).
+
+    A thin wrapper over the blockwise :func:`knn_points`, so the candidate
+    build streams [block, n] distance tiles and never materialises the
+    [n, n] matrix. Slots are ordered by increasing PC distance (the padded
+    layout the SparseCoclusterAccumulator and its top-k extraction consume).
+    Degenerate n <= m inputs repeat the last neighbour, exactly like every
+    other kNN here — a duplicated slot carries the same exact counts as its
+    twin, so the restricted-count parity contract is unaffected.
+    """
+    idx, _ = knn_points(x, m, block=block, compute_dtype=compute_dtype)
+    return idx
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def knn_from_distance(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN given a precomputed [n, n] distance matrix (the consensus

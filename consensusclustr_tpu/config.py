@@ -134,11 +134,27 @@ class ClusterConfig:
     # checkpoint writes); results are bit-identical at any depth — the window
     # only changes when chunks are fetched, never what was dispatched.
     pipeline_depth: Optional[int] = None
-    # Dense [n, n] consensus-matrix assembly: None = auto (dense up to
-    # 16384 cells, blockwise streaming above — consensus/blockwise.py), or
-    # force with True/False. The blockwise path computes the consensus kNN
-    # graph and merge statistics from [block, n] tiles and never holds the
-    # full matrix; its ConsensusResult carries jaccard_dist=None.
+    # Consensus-accumulator regime (consensus/pipeline.py, ISSUE 9):
+    # None = auto — dense up to DENSE_CONSENSUS_LIMIT cells (16384;
+    # CCTPU_DENSE_CONSENSUS_LIMIT overrides), the kNN-restricted
+    # ``sparse_knn`` accumulator above it (O(n·m) memory/FLOPs instead of
+    # O(n²)). Explicit values: "dense" (the [n, n] einsum oracle), "pallas"
+    # (the [n, n] Mosaic tile kernel forced), "blockwise" ([block, n]
+    # streaming tiles), "sparse_knn". An explicit dense regime above the
+    # limit raises loudly instead of OOMing. Takes precedence over the
+    # legacy ``dense_consensus`` bool below.
+    consensus_regime: Optional[str] = None
+    # Per-cell candidate-set width m for the sparse_knn regime: the top-m
+    # PC-space neighbours whose pairs the restricted accumulator counts.
+    # None = auto (max(64, 2*max(k_num)), clipped to n-1). On candidate
+    # pairs the restricted counts are integer-exactly the dense counts
+    # (tools/parity_audit.py --pair dense:sparse_knn).
+    sparse_knn_candidates: Optional[int] = None
+    # Legacy dense/blockwise switch (pre-ISSUE-9): None = auto, or force
+    # dense [n, n] assembly with True / blockwise streaming with False.
+    # The blockwise path computes the consensus kNN graph and merge
+    # statistics from [block, n] tiles and never holds the full matrix;
+    # its ConsensusResult carries jaccard_dist=None.
     dense_consensus: Optional[bool] = None
     # Distributed execution: None = single chip; "auto" = shard over all
     # visible devices when >1; or an explicit jax.sharding.Mesh built by
@@ -221,6 +237,20 @@ class ClusterConfig:
             raise ValueError(
                 f"numerics must be None, 'off', 'watch' or 'audit'; got "
                 f"{self.numerics!r}"
+            )
+        if self.consensus_regime is not None and self.consensus_regime not in (
+            "dense", "pallas", "blockwise", "sparse_knn"
+        ):
+            raise ValueError(
+                f"consensus_regime must be None, 'dense', 'pallas', "
+                f"'blockwise' or 'sparse_knn'; got {self.consensus_regime!r}"
+            )
+        if self.sparse_knn_candidates is not None and int(
+            self.sparse_knn_candidates
+        ) < 2:
+            raise ValueError(
+                f"sparse_knn_candidates must be >= 2; got "
+                f"{self.sparse_knn_candidates}"
             )
         if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
             raise ValueError(

@@ -220,3 +220,147 @@ class CoclusterAccumulator:
         global LAST_PATH
         LAST_PATH = "einsum"
         return _finalize_cocluster_distance(self._agree, self._union)
+
+
+# -- kNN-restricted sparse accumulator (ISSUE 9) ------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sparse_accum_update(chunk: int):
+    """Donated sparse-count step, lazily wrapped like _make_accum_update (no
+    compile_cache/obs import at module import time; one jit cache per chunk
+    width shared across accumulator instances)."""
+    from consensusclustr_tpu.utils.compile_cache import counting_jit
+
+    @counting_jit(donate_argnums=(0, 1))
+    def _accum_sparse_cocluster_counts(agree, union, labels, cand_idx):
+        b, n = labels.shape
+        pad = (-b) % chunk
+        if pad:  # bucket the boot axis so ragged tails reuse the executable
+            labels = jnp.concatenate(
+                [labels, jnp.full((pad, n), -1, jnp.int32)], axis=0
+            )
+
+        def step(carry, row):
+            # One boot row: gather each cell's candidate-neighbour labels and
+            # count agree/union ONLY on those pairs — the [n, m] transient is
+            # the whole working set (no [n, n], no one-hot). Padded all--1
+            # rows contribute nothing (vv is false everywhere).
+            agree, union = carry
+            valid = row >= 0                                     # [n]
+            nbr = row[cand_idx]                                  # [n, m]
+            vv = valid[:, None] & (nbr >= 0)
+            agree = agree + jnp.where(
+                vv & (row[:, None] == nbr), 1.0, 0.0
+            ).astype(jnp.float32)
+            union = union + jnp.where(vv, 1.0, 0.0).astype(jnp.float32)
+            return (agree, union), None
+
+        (agree, union), _ = jax.lax.scan(step, (agree, union), labels)
+        return agree, union
+
+    return _accum_sparse_cocluster_counts
+
+
+@jax.jit
+def _finalize_sparse_distance(agree: jax.Array, union: jax.Array) -> jax.Array:
+    """[n, m] restricted co-clustering distance — the same finalize formula
+    as the dense path (union 0 -> distance 1); the diagonal repair is moot
+    because candidate sets exclude self."""
+    jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
+    return 1.0 - jac
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _sparse_knn_extract(cand_idx: jax.Array, dist: jax.Array, k: int):
+    """Top-k of the restricted distances per row -> (idx [n, k] int32 into
+    cells, dist [n, k] f32), increasing distance. Ties break by candidate
+    slot (= PC-distance rank), where the dense knn_from_distance breaks by
+    cell index — a documented, deliberate difference (docs/perf.md)."""
+    m = dist.shape[1]
+    k_eff = min(k, m)
+    neg, sel = jax.lax.top_k(-dist, k_eff)
+    idx = jnp.take_along_axis(cand_idx, sel, axis=1)
+    if k_eff < k:  # degenerate m < k: pad with the last neighbour
+        pad = k - k_eff
+        idx = jnp.concatenate([idx, jnp.repeat(idx[:, -1:], pad, axis=1)], axis=1)
+        neg = jnp.concatenate([neg, jnp.repeat(neg[:, -1:], pad, axis=1)], axis=1)
+    return idx.astype(jnp.int32), -neg
+
+
+class SparseCoclusterAccumulator:
+    """kNN-restricted streaming co-clustering counts (ISSUE 9 tentpole).
+
+    The dense accumulator above carries two [n, n] count matrices — the
+    O(n²) wall that caps every regime (6.9 GB RSS at the 50k north star,
+    ~2.7 TB extrapolated to 1M cells). This accumulator restricts the pair
+    universe to each cell's ``cand_idx`` [n, m] candidate-neighbour set
+    (cluster/knn.py::knn_candidates, top-m in PC space) and carries [n, m]
+    agree/union counts instead: O(n·m) memory and FLOPs end to end, donated
+    in place per chunk exactly like the dense carries, fed from the same
+    ChunkPipeline ``on_enqueue`` hook.
+
+    Restriction contract (pinned by ``tools/parity_audit.py --pair
+    dense:sparse_knn`` and tests/test_sparse_consensus.py): for every
+    candidate pair ``(i, cand_idx[i, s])`` the agree/union counts equal the
+    dense accumulator's ``[i, cand_idx[i, s]]`` entries *integer-exactly* —
+    the restriction changes WHICH pairs are counted, never a single count.
+    ``consensus_knn`` then yields the consensus graph directly in kNN form,
+    so the downstream grid skips the dense-distance -> kNN re-extraction.
+    """
+
+    def __init__(self, cand_idx, chunk: int = 32):
+        cand_idx = jnp.asarray(cand_idx, jnp.int32)
+        if cand_idx.ndim != 2:
+            raise ValueError(
+                f"cand_idx must be [n, m]; got shape {cand_idx.shape}"
+            )
+        self.n, self.m = (int(s) for s in cand_idx.shape)
+        self._cand = jax.device_put(cand_idx)
+        self._update = _make_sparse_accum_update(int(chunk))
+        self._agree = jnp.zeros((self.n, self.m), jnp.float32)
+        self._union = jnp.zeros((self.n, self.m), jnp.float32)
+        self.chunks = 0
+        self.rows = 0
+
+    @property
+    def candidate_idx(self) -> jax.Array:
+        """[n, m] int32 candidate sets (read-only view)."""
+        return self._cand
+
+    @property
+    def accumulated_pairs(self) -> int:
+        """Directed pairs the accumulator tracks (n * m) — vs the dense
+        regime's n²; the ratio is the ``pairs_ratio`` span attr."""
+        return self.n * self.m
+
+    def update(self, labels) -> None:
+        """Fold a [rows, n] int32 label batch (-1 = unsampled) into the
+        restricted counts; donates the previous carries, dispatches async —
+        the same contract as CoclusterAccumulator.update."""
+        labels = jnp.asarray(labels, jnp.int32)
+        if labels.ndim != 2 or labels.shape[1] != self.n:
+            raise ValueError(
+                f"label batch shape {labels.shape} incompatible with n={self.n}"
+            )
+        self._agree, self._union = self._update(
+            self._agree, self._union, labels, self._cand
+        )
+        self.chunks += 1
+        self.rows += int(labels.shape[0])
+
+    def carries(self) -> tuple:
+        """The live (agree, union) [n, m] carries — fingerprinted at the
+        ``cocluster`` checkpoint; integer counts in f32, so chunk-order
+        invariant exactly like the dense carries."""
+        return self._agree, self._union
+
+    def distances(self) -> jax.Array:
+        """[n, m] restricted co-clustering distance of everything so far."""
+        return _finalize_sparse_distance(self._agree, self._union)
+
+    def consensus_knn(self, k: int):
+        """(idx [n, k], dist [n, k]) consensus kNN graph straight from the
+        restricted counts — the sparse regime's ``consensus_dist`` artifact
+        (already graph-form; no dense matrix ever exists)."""
+        return _sparse_knn_extract(self._cand, self.distances(), k)

@@ -102,6 +102,159 @@ def stability_matrix(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("n_clusters",))
+def restricted_pair_stats(
+    agree: jax.Array,     # [n, m] restricted agree counts
+    union: jax.Array,     # [n, m] restricted union counts
+    cand_idx: jax.Array,  # [n, m] candidate-neighbour indices
+    codes: jax.Array,     # [n] int32 cluster ids in [0, n_clusters)
+    n_clusters: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """(sums [C, C], pair_counts [C, C]) of co-clustering distances over the
+    *restricted* (candidate) pairs, bucketed by (codes[i], codes[j]).
+
+    The sparse regime's replacement for ``blockwise.cocluster_pair_sums``:
+    the restricted counts are already in hand, so the cluster-pair merge
+    statistics cost one O(n·m) segment-sum instead of streaming O(n²)
+    distance tiles. Directed (j in cand[i] does not imply the reverse);
+    consumers symmetrise. Pairs outside every candidate set contribute
+    nothing — the mean is over candidate pairs, not member pairs
+    (docs/perf.md "Choosing a consensus regime" discusses when that
+    restriction is safe)."""
+    dist = jnp.where(union > 0, 1.0 - agree / jnp.maximum(union, 1.0), 1.0)
+    ci = jnp.asarray(codes, jnp.int32)[:, None]               # [n, 1]
+    cj = jnp.asarray(codes, jnp.int32)[cand_idx]              # [n, m]
+    flat = (ci * n_clusters + cj).reshape(-1)
+    sums = jnp.zeros((n_clusters * n_clusters,), jnp.float32).at[flat].add(
+        dist.reshape(-1)
+    )
+    counts = jnp.zeros((n_clusters * n_clusters,), jnp.float32).at[flat].add(1.0)
+    return sums.reshape(n_clusters, n_clusters), counts.reshape(
+        n_clusters, n_clusters
+    )
+
+
+def merge_small_clusters_from_pair_stats(
+    sums: np.ndarray,
+    pair_counts: np.ndarray,
+    labels: np.ndarray,
+    min_size: int,
+) -> np.ndarray:
+    """Small-cluster merge (reference :462-467) from restricted pair stats.
+
+    The same host loop as ``blockwise.merge_small_clusters_from_sums`` but
+    with an explicit per-pair count matrix (under the kNN restriction the
+    pair count between clusters a and b is the number of candidate edges
+    between them, not |a|·|b|). Directed inputs are symmetrised up front.
+    A small cluster with no candidate edge into any live cluster (fully
+    isolated in the restriction) folds into the largest live cluster — the
+    deterministic stand-in for the dense path's always-finite argmin."""
+    labels = np.asarray(labels, np.int32).copy()
+    sums = np.asarray(sums, np.float64)
+    sums = sums + sums.T
+    pc = np.asarray(pair_counts, np.float64)
+    pc = pc + pc.T
+    member = np.bincount(labels, minlength=sums.shape[0]).astype(np.float64)
+    while True:
+        live = np.where(member > 0)[0]
+        if len(live) <= 1:
+            return labels
+        smallest = live[np.argmin(member[live])]
+        if member[live].min() >= min_size:
+            return labels
+        with np.errstate(invalid="ignore", divide="ignore"):
+            row = np.where(
+                pc[smallest] > 0, sums[smallest] / np.maximum(pc[smallest], 1.0),
+                np.inf,
+            )
+        row[smallest] = np.inf
+        row[member <= 0] = np.inf
+        if np.isfinite(row).any():
+            target = int(np.argmin(row))
+        else:
+            others = live[live != smallest]
+            target = int(others[np.argmax(member[others])])
+        labels[labels == smallest] = target
+        # fold row then column: the diagonal picks up all four terms
+        sums[target, :] += sums[smallest, :]
+        sums[:, target] += sums[:, smallest]
+        sums[smallest, :] = 0.0
+        sums[:, smallest] = 0.0
+        pc[target, :] += pc[smallest, :]
+        pc[:, target] += pc[:, smallest]
+        pc[smallest, :] = 0.0
+        pc[:, smallest] = 0.0
+        member[target] += member[smallest]
+        member[smallest] = 0.0
+
+
+def restricted_cluster_distance(
+    agree: np.ndarray,
+    union: np.ndarray,
+    cand_idx: np.ndarray,
+    codes: np.ndarray,
+    n_clusters: int,
+) -> np.ndarray:
+    """[C, C] mean restricted co-clustering distance between final clusters —
+    the sparse regime's dendrogram input (the determineHierachy
+    return="distance" analog, reference :621) without any [n, n] pass.
+    Cluster pairs with no candidate edge get +inf (joined last)."""
+    sums, pc = restricted_pair_stats(
+        jnp.asarray(agree, jnp.float32), jnp.asarray(union, jnp.float32),
+        jnp.asarray(cand_idx, jnp.int32), jnp.asarray(codes, jnp.int32),
+        int(n_clusters),
+    )
+    sums = np.asarray(sums, np.float64)
+    pc = np.asarray(pc, np.float64)
+    sums = sums + sums.T
+    pc = pc + pc.T
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = np.where(pc > 0, sums / np.maximum(pc, 1.0), np.inf)
+    # the dendrogram's diagonal is never read, but keep it sane (self
+    # distance 0 on occupied clusters)
+    occupied = np.asarray(np.bincount(
+        np.asarray(codes, np.int64), minlength=int(n_clusters)
+    ) > 0)
+    out[np.diag_indices_from(out)] = np.where(occupied, 0.0, np.inf)
+    return out
+
+
+def stability_from_restricted_counts(
+    agree: np.ndarray,
+    union: np.ndarray,
+    cand_idx: np.ndarray,
+    codes: np.ndarray,
+    n_clusters: int,
+) -> np.ndarray:
+    """[C] per-cluster stability from the restricted counts: the mean
+    co-clustering rate (agree/union) over *within-cluster* candidate pairs.
+
+    The sparse regime's stability diagonal for serving (serve/artifact.py
+    ``stability_source = "cocluster_restricted"``): in [0, 1], 1 when every
+    within-cluster candidate pair always co-clusters. Clusters with no
+    within-cluster candidate pair (singletons under the restriction) get
+    1.0 — the same repair as stability_matrix's NaN -> 1. Host numpy: the
+    inputs are [n, m] and the loop-free reductions are cheap."""
+    agree = np.asarray(agree, np.float64)
+    union = np.asarray(union, np.float64)
+    codes = np.asarray(codes, np.int64)
+    cand_idx = np.asarray(cand_idx, np.int64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        jac = np.where(union > 0, agree / np.maximum(union, 1.0), 0.0)
+    same = (codes[:, None] == codes[cand_idx]) & (union > 0)
+    num = np.bincount(
+        codes.repeat(cand_idx.shape[1])[same.reshape(-1)],
+        weights=jac.reshape(-1)[same.reshape(-1)], minlength=int(n_clusters),
+    )
+    den = np.bincount(
+        codes.repeat(cand_idx.shape[1])[same.reshape(-1)],
+        minlength=int(n_clusters),
+    )
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = np.where(den > 0, num / np.maximum(den, 1.0), 1.0)
+    return out.astype(np.float32)
+
+
 def merge_unstable_clusters(
     consensus: np.ndarray,
     boot_labels: np.ndarray,

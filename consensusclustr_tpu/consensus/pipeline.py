@@ -37,7 +37,7 @@ from consensusclustr_tpu.cluster.engine import (
     resolve_grid_impl,
     ties_last_argmax as _ties_last_argmax,
 )
-from consensusclustr_tpu.cluster.knn import knn_from_distance
+from consensusclustr_tpu.cluster.knn import knn_candidates, knn_from_distance
 from consensusclustr_tpu.cluster.leiden import _auto_kc as _leiden_auto_kc
 from consensusclustr_tpu.cluster.leiden import compact_labels
 from consensusclustr_tpu.cluster.metrics import mean_silhouette_score
@@ -46,6 +46,7 @@ from consensusclustr_tpu.cluster.snn import snn_graph
 from consensusclustr_tpu.consensus.bootstrap import bootstrap_indices
 from consensusclustr_tpu.consensus.cocluster import (
     CoclusterAccumulator,
+    SparseCoclusterAccumulator,
     _pallas_wanted,
     coclustering_distance,
 )
@@ -73,16 +74,107 @@ from consensusclustr_tpu.utils.log import LevelLog
 from consensusclustr_tpu.utils.rng import cluster_key
 
 
-DENSE_CONSENSUS_LIMIT = 16384  # cells; above this the blockwise path is auto
+# Cells above which the auto-selected regime stops materialising the dense
+# [n, n] consensus matrix (sparse_knn above, ISSUE 9; CCTPU_DENSE_CONSENSUS_LIMIT
+# overrides — also the escape hatch the explicit-dense guard names).
+DENSE_CONSENSUS_LIMIT = 16384
+
+# The single-chip bootstrapped-consensus regimes (ClusterConfig.consensus_regime):
+#   dense      — the [n, n] einsum oracle (streamed donated carries)
+#   pallas     — the [n, n] regime with the Mosaic tile kernel forced
+#   blockwise  — [block, n] streaming tiles, consensus kNN only (PR pre-9 scale path)
+#   sparse_knn — kNN-restricted [n, m] accumulator, O(n·m) end to end (ISSUE 9)
+CONSENSUS_REGIMES = ("dense", "pallas", "blockwise", "sparse_knn")
+
+# Span-attr literals stamped on the candidates/cocluster spans (registered in
+# obs/schema.py::CONSENSUS_SPAN_ATTRS; tools/check_obs_schema.py validates
+# both directions — a renamed attr is a test failure, not a silently empty
+# "== consensus ==" table in tools/report.py).
+REGIME_ATTR = "consensus_regime"        # which regime assembled the consensus
+CANDIDATE_M_ATTR = "candidate_m"        # sparse regime's per-cell candidate count
+PAIRS_ATTR = "accumulated_pairs"        # pairs the accumulator tracked
+PAIRS_RATIO_ATTR = "pairs_ratio"        # accumulated pairs / n^2
+
+
+def dense_consensus_limit() -> int:
+    """The dense [n, n] cell ceiling: CCTPU_DENSE_CONSENSUS_LIMIT env
+    override, else DENSE_CONSENSUS_LIMIT."""
+    raw = os.environ.get("CCTPU_DENSE_CONSENSUS_LIMIT")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return DENSE_CONSENSUS_LIMIT
+
+
+def resolve_consensus_regime(cfg: ClusterConfig, n: int) -> str:
+    """One of CONSENSUS_REGIMES for a single-chip bootstrapped consensus over
+    ``n`` cells.
+
+    Resolution: explicit ``cfg.consensus_regime`` wins; the legacy
+    ``cfg.dense_consensus`` bool maps True -> dense / False -> blockwise;
+    auto picks dense up to :func:`dense_consensus_limit` and sparse_knn
+    above it (the ISSUE 9 default-at-scale switch).
+
+    Footgun guard: a dense regime (explicit field OR legacy
+    dense_consensus=True) above the limit raises loudly instead of
+    silently materialising the [n, n] matrices and dying in an OOM —
+    the error names the CCTPU_DENSE_CONSENSUS_LIMIT override for callers
+    who really mean it. Auto never trips the guard.
+    """
+    limit = dense_consensus_limit()
+    regime = cfg.consensus_regime
+    if regime is None:
+        if cfg.dense_consensus is not None:
+            regime = "dense" if cfg.dense_consensus else "blockwise"
+        else:
+            return "dense" if n <= limit else "sparse_knn"
+    if regime in ("dense", "pallas") and n > limit:
+        gb = 2 * n * n * 4 / 1e9
+        raise ValueError(
+            f"dense consensus at n={n} cells would materialise two [n, n] "
+            f"count carries (~{gb:.1f} GB) — refusing above "
+            f"DENSE_CONSENSUS_LIMIT={limit}. Use "
+            f"consensus_regime='sparse_knn' (O(n*m), the at-scale default) "
+            f"or 'blockwise', or raise the CCTPU_DENSE_CONSENSUS_LIMIT env "
+            f"var to force the dense path anyway."
+        )
+    return regime
+
+
+def resolve_candidate_m(cfg: ClusterConfig, n: int, k_list) -> int:
+    """Per-cell candidate-set width for the sparse regime:
+    ``cfg.sparse_knn_candidates`` or ``max(64, 2 * max(k))``, never below
+    the largest consensus-graph k (the grid needs that many neighbours) and
+    never above n - 1 (self excluded)."""
+    m = cfg.sparse_knn_candidates
+    if m is None:
+        m = max(64, 2 * max(k_list))
+    m = max(int(m), max(k_list))
+    return max(2, min(m, n - 1))
+
+
+class SparseConsensus(NamedTuple):
+    """The sparse regime's restricted-count state, carried on ConsensusResult
+    so downstream consumers (small-cluster merge, dendrogram, serving
+    stability diagonal) stay O(n·m) instead of re-streaming O(n²) tiles."""
+
+    cand_idx: np.ndarray   # [n, m] int32 candidate-neighbour sets
+    agree: np.ndarray      # [n, m] f32 integer agree counts
+    union: np.ndarray      # [n, m] f32 integer union counts
+    m: int                 # candidate count per cell
 
 
 class ConsensusResult(NamedTuple):
     labels: np.ndarray                 # [n] compact consensus labels
     silhouette: float                  # mean approx-silhouette of labels on PCA
     jaccard_dist: Optional[np.ndarray]  # [n, n] co-clustering distance (None if
-    #                                     nboots<=1 OR the blockwise path ran)
+    #                                     nboots<=1 OR a non-dense regime ran)
     boot_labels: Optional[np.ndarray]   # [B(,*K*R), n] aligned boot assignments
     n_clusters: int
+    regime: str = "dense"               # CONSENSUS_REGIMES entry that ran
+    sparse: Optional[SparseConsensus] = None  # sparse_knn regime state
 
 
 @counting_jit(
@@ -187,7 +279,8 @@ def run_bootstraps(
     candidate axis — |k_num| * |res_range| rows per boot — so the grid shape
     is part of the fingerprint.
 
-    ``accumulator`` (a CoclusterAccumulator) streams each chunk's aligned
+    ``accumulator`` (a CoclusterAccumulator or SparseCoclusterAccumulator —
+    anything with ``update(labels [rows, n])``) streams each chunk's aligned
     labels into the donated co-clustering counts the moment the chunk is
     enqueued: computed chunks feed their DEVICE label batch (the accumulator
     update rides the async stream behind the chunk itself — no host round
@@ -471,17 +564,36 @@ def _finish_consensus(
     cfg: ClusterConfig,
     k_list,
     log: Optional[LevelLog],
+    regime: str = "dense",
+    sparse: Optional[SparseConsensus] = None,
 ) -> ConsensusResult:
     """Shared tail of the bootstrap paths: small-cluster merge (:461-467),
     stability merge (:469-497), final silhouette.
 
-    dist_np=None is the blockwise regime: the small-cluster merge runs on
-    streamed cluster-pair sums instead of the dense matrix."""
+    dist_np=None is a streaming regime: the small-cluster merge runs on the
+    sparse regime's restricted pair stats (O(n·m), the counts are already in
+    hand) or on blockwise cluster-pair tile sums, instead of the dense
+    matrix."""
     with maybe_span(log, "merge"):
         if dist_np is not None:
             # small-cluster merge on co-clustering distances (:461-467)
             labels = merge_small_clusters(
                 dist_np, labels, max(k_list[0], 20), cfg.max_clusters
+            )
+        elif sparse is not None:
+            from consensusclustr_tpu.consensus.merge import (
+                merge_small_clusters_from_pair_stats,
+                restricted_pair_stats,
+            )
+
+            sums, pair_counts = restricted_pair_stats(
+                jnp.asarray(sparse.agree), jnp.asarray(sparse.union),
+                jnp.asarray(sparse.cand_idx), jnp.asarray(labels, jnp.int32),
+                cfg.max_clusters,
+            )
+            labels = merge_small_clusters_from_pair_stats(
+                np.asarray(sums), np.asarray(pair_counts), labels,
+                max(k_list[0], 20),
             )
         else:
             from consensusclustr_tpu.consensus.blockwise import (
@@ -515,6 +627,8 @@ def _finish_consensus(
         jaccard_dist=dist_np,
         boot_labels=boot_labels,
         n_clusters=len(np.unique(labels)),
+        regime=regime,
+        sparse=sparse,
     )
 
 
@@ -546,9 +660,17 @@ def consensus_cluster(
             distributed_consensus_cluster,
         )
 
-        dense = cfg.dense_consensus
-        if dense is None:
-            dense = n <= DENSE_CONSENSUS_LIMIT
+        # The mesh path has no sparse regime yet (ROADMAP O2): an explicit
+        # sparse_knn/blockwise request maps to the sharded blockwise
+        # streaming path, dense/pallas to the sharded dense assembly. The
+        # explicit-dense footgun guard does not apply here — sharded dense
+        # spreads the [n, n] rows across devices by design.
+        if cfg.consensus_regime is not None:
+            dense = cfg.consensus_regime in ("dense", "pallas")
+        else:
+            dense = cfg.dense_consensus
+            if dense is None:
+                dense = n <= dense_consensus_limit()
         with maybe_span(
             log, "consensus_distributed",
             mesh={k: v for k, v in mesh.shape.items()},
@@ -563,7 +685,8 @@ def consensus_cluster(
                 mesh={k: v for k, v in mesh.shape.items()},
             )
         return _finish_consensus(
-            pca, labels_np, dist_np, boot_labels, cfg, k_list, log
+            pca, labels_np, dist_np, boot_labels, cfg, k_list, log,
+            regime="dense" if dense else "blockwise",
         )
 
     if cfg.nboots <= 1:
@@ -579,10 +702,11 @@ def consensus_cluster(
         best = int(_ties_last_argmax(grid.scores))
         labels = np.asarray(grid.labels[best])
         # Euclidean small-cluster merge (:504-510): dense matrix below the
-        # scale threshold, streamed cluster-pair sums above it
-        dense = cfg.dense_consensus
-        if dense is None:
-            dense = n <= DENSE_CONSENSUS_LIMIT
+        # scale threshold, streamed cluster-pair sums above it. There is no
+        # co-clustering here, so sparse_knn/blockwise both mean "streamed";
+        # the resolver also supplies the explicit-dense footgun guard (the
+        # [n, n] Euclidean matrix is the same OOM).
+        dense = resolve_consensus_regime(cfg, n) in ("dense", "pallas")
         if dense:
             d2 = np.asarray(
                 jnp.sqrt(jnp.maximum(
@@ -613,21 +737,46 @@ def consensus_cluster(
         return ConsensusResult(
             labels=labels, silhouette=sil, jaccard_dist=None, boot_labels=None,
             n_clusters=len(np.unique(labels)),
+            regime="dense" if dense else "blockwise",
         )
 
-    dense = cfg.dense_consensus
-    if dense is None:
-        dense = n <= DENSE_CONSENSUS_LIMIT
+    regime = resolve_consensus_regime(cfg, n)
+    dense = regime in ("dense", "pallas")
+    # Explicit regime names fold the kernel choice in: "pallas" forces the
+    # tile kernel, "dense" names the einsum oracle. Auto / legacy
+    # dense_consensus keep cfg.use_pallas's dispatch — the pre-ISSUE-9
+    # behavior, bit-identical below the threshold.
+    if regime == "pallas":
+        use_pallas = True
+    elif cfg.consensus_regime == "dense":
+        use_pallas = False
+    else:
+        use_pallas = cfg.use_pallas
     # Dense einsum regime: stream the co-clustering counts into a donated
     # accumulator DURING the boot fan-out (each chunk's device labels feed an
     # in-place [n, n] count update on the async stream) instead of one
     # fused pass over all rows afterwards — bit-identical (integer counts),
     # but the consensus matrix is ready the moment the boots drain and the
     # accumulator never double-buffers. The Pallas regime keeps the one-shot
-    # tiled kernel (it wants the full int8 label matrix at once).
+    # tiled kernel (it wants the full int8 label matrix at once). The
+    # sparse_knn regime (ISSUE 9) restricts the pair universe to each cell's
+    # top-m PC-space neighbours and streams [n, m] donated carries the same
+    # way — O(n·m) end to end; its consensus distance is born in kNN-graph
+    # form, so the grid below consumes it directly.
     accum = None
-    if dense and cfg.nboots > 1 and not _pallas_wanted(cfg.use_pallas, cfg.max_clusters):
+    cand_idx = None
+    if dense and cfg.nboots > 1 and not _pallas_wanted(use_pallas, cfg.max_clusters):
         accum = CoclusterAccumulator(n, cfg.max_clusters)
+    elif regime == "sparse_knn":
+        m_cand = resolve_candidate_m(cfg, n, k_list)
+        with maybe_span(
+            log, "candidates", **{CANDIDATE_M_ATTR: m_cand}
+        ) as sp:
+            cand_idx = knn_candidates(
+                pca, m_cand, compute_dtype=cfg.compute_dtype
+            )
+            sp.value = cand_idx
+        accum = SparseCoclusterAccumulator(cand_idx)
     # Resource bracket (obs/resource.py): the boots + cocluster phases are
     # where the O(n²) consensus memory materializes (ROADMAP O1), so sampling
     # covers at least this region even for direct consensus_cluster callers
@@ -638,9 +787,11 @@ def consensus_cluster(
         boot_labels, boot_scores = run_bootstraps(
             key, pca, cfg, log, accumulator=accum
         )
+        sparse_state = None
         if dense:
             with maybe_span(
-                log, "cocluster", dense=True, streamed=accum is not None
+                log, "cocluster", dense=True, streamed=accum is not None,
+                **{REGIME_ATTR: regime},
             ) as sp:
                 if accum is not None:
                     # the streamed count carries, fingerprinted before
@@ -652,7 +803,7 @@ def consensus_cluster(
                 else:
                     dist = coclustering_distance(
                         jnp.asarray(boot_labels, jnp.int32), cfg.max_clusters,
-                        use_pallas=cfg.use_pallas,
+                        use_pallas=use_pallas,
                     )
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, dist)
                 sp.value = dist
@@ -663,15 +814,53 @@ def consensus_cluster(
                 )
                 sp.value = (cons_labels, cons_scores)
             dist_np = np.asarray(dist)
+        elif regime == "sparse_knn":
+            with maybe_span(
+                log, "cocluster", dense=False,
+                **{
+                    REGIME_ATTR: regime,
+                    CANDIDATE_M_ATTR: accum.m,
+                    PAIRS_ATTR: accum.accumulated_pairs,
+                    PAIRS_RATIO_ATTR: round(
+                        accum.accumulated_pairs / float(n * n), 6
+                    ),
+                },
+            ) as sp:
+                # the restricted count carries, fingerprinted before
+                # finalize — chunk-order invariant (integer counts), and on
+                # candidate pairs integer-exactly equal to the dense counts
+                # (tools/parity_audit.py --pair dense:sparse_knn)
+                numeric_checkpoint(log, COCLUSTER_CKPT, lambda: accum.carries())
+                # the consensus distance is born in kNN-graph form: no dense
+                # matrix, no dense-distance -> kNN re-extraction downstream
+                knn_idx, _ = accum.consensus_knn(max(k_list))
+                numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
+                sp.value = knn_idx
+            with maybe_span(log, "consensus_grid") as sp:
+                cons_labels, cons_scores = _consensus_grid_from_knn(
+                    key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
+                    cluster_fun=cfg.cluster_fun,
+                )
+                sp.value = (cons_labels, cons_scores)
+            agree, union = accum.carries()
+            sparse_state = SparseConsensus(
+                cand_idx=np.asarray(accum.candidate_idx),
+                agree=np.asarray(agree),
+                union=np.asarray(union),
+                m=accum.m,
+            )
+            dist_np = None
         else:
             from consensusclustr_tpu.consensus.blockwise import (
                 blockwise_consensus_knn,
             )
 
-            with maybe_span(log, "cocluster", dense=False) as sp:
+            with maybe_span(
+                log, "cocluster", dense=False, **{REGIME_ATTR: regime}
+            ) as sp:
                 knn_idx, _ = blockwise_consensus_knn(
                     jnp.asarray(boot_labels, jnp.int32), max(k_list),
-                    cfg.max_clusters, use_pallas=cfg.use_pallas,
+                    cfg.max_clusters, use_pallas=use_pallas,
                 )
                 # blockwise regime: the [n, n] matrix never exists — the
                 # consensus kNN graph is the comparable downstream artifact
@@ -689,6 +878,9 @@ def consensus_cluster(
         log.event(
             "consensus", n_clusters=len(np.unique(labels)),
             best_score=float(np.max(np.asarray(cons_scores))),
-            dense=bool(dense),
+            dense=bool(dense), regime=regime,
         )
-    return _finish_consensus(pca, labels, dist_np, boot_labels, cfg, k_list, log)
+    return _finish_consensus(
+        pca, labels, dist_np, boot_labels, cfg, k_list, log,
+        regime=regime, sparse=sparse_state,
+    )

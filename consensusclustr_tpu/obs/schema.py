@@ -56,6 +56,12 @@ from __future__ import annotations
 # bench rungs carry ``labels_fingerprint`` and tools/parity_audit.py diffs
 # two regimes' checkpoint streams. See docs/quirks.md
 # "Observability schema v5 → v6".
+# ISSUE 9 (sparse consensus) deliberately did NOT bump the version: the
+# ``candidates`` span, the CONSENSUS_SPAN_ATTRS regime attrs and the bench
+# ``sparse_consensus`` sub-rung are purely additive (same precedent as the
+# serve/ names landing inside v1), no registered name changed meaning and
+# the RunRecord grew no field. See docs/quirks.md "Consensus regimes and
+# the sparse_knn auto-switch".
 SCHEMA_VERSION = 6
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
@@ -115,6 +121,7 @@ SPAN_NAMES = frozenset({
     "significance",
     # consensus/pipeline.py
     "boots",
+    "candidates",       # sparse_knn regime: the PC-space candidate-set build
     "cocluster",
     "consensus_grid",
     "merge",
@@ -214,4 +221,15 @@ NUMERIC_CHECKPOINTS = frozenset({
 NUMERIC_SPAN_ATTRS = frozenset({
     "fingerprints",          # audit: {checkpoint: checksum} on the open span
     "numerics_nonfinite",    # watchdog: NaN/Inf count tagged on the span
+})
+
+# Span attrs stamped by consensus/pipeline.py on the candidates/cocluster
+# spans (ISSUE 9 — the regime provenance tools/report.py's "== consensus =="
+# table renders). tools/check_obs_schema.py validates the ``*_ATTR``
+# literals there against this set, both directions.
+CONSENSUS_SPAN_ATTRS = frozenset({
+    "consensus_regime",   # which CONSENSUS_REGIMES entry assembled the consensus
+    "candidate_m",        # sparse_knn: candidate-neighbour count per cell
+    "accumulated_pairs",  # pairs the accumulator tracked (n*m sparse, n^2 dense)
+    "pairs_ratio",        # accumulated_pairs / n^2 — the sub-quadratic ratio
 })

@@ -115,6 +115,13 @@ class ReferenceFit:
     stability: Optional[np.ndarray] = None     # [C_leaf] per-cluster bootstrap
     #                                            stability, leaf_label_table order
     n_genes_full: Optional[int] = None         # width of the full gene space
+    # How the stability diagonal was derived (ISSUE 9): "boot_rand" = the
+    # per-boot pairwise-Rand stability matrix diagonal (dense/blockwise
+    # regimes), "cocluster_restricted" = mean within-cluster candidate-pair
+    # co-clustering rate from the sparse_knn regime's restricted counts.
+    # None on legacy captures; recorded in the bundle manifest so a serving
+    # operator can tell which estimator a model's confidences come from.
+    stability_source: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -133,6 +140,7 @@ class ReferenceArtifact:
     hvg_indices: Optional[np.ndarray] = None
     gene_names: Optional[np.ndarray] = None
     n_genes_full: Optional[int] = None
+    stability_source: Optional[str] = None  # see ReferenceFit.stability_source
     manifest: dict = dataclasses.field(default_factory=dict)
 
     # -- shape views ---------------------------------------------------------
@@ -223,6 +231,7 @@ class ReferenceArtifact:
             "n_leaf_clusters": len(self.leaf_table),
             "level_tables": self.level_tables,
             "libsize_mean": float(self.libsize_mean),
+            "stability_source": self.stability_source,
             "created_unix": time.time(),
             "config_fingerprint": fingerprint,
             "config": snapshot,
@@ -278,6 +287,7 @@ class ReferenceArtifact:
             n_genes_full=(
                 int(arrays["n_genes_full"]) if "n_genes_full" in arrays else None
             ),
+            stability_source=manifest.get("stability_source"),
             manifest=manifest,
         )
 
@@ -314,6 +324,7 @@ def reference_from_result(result: Any, config: Any = None) -> ReferenceArtifact:
         hvg_indices=fit.hvg_indices,
         gene_names=fit.gene_names,
         n_genes_full=fit.n_genes_full,
+        stability_source=getattr(fit, "stability_source", None),
     )
 
 

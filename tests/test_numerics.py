@@ -317,12 +317,16 @@ class TestParityAudit:
     def test_all_pair_presets_zero_divergence(self, audit):
         """Acceptance: zero divergent checkpoints across dense:pallas,
         fused:looped, depth1:depth4 (and x64:x32) on the seeded CPU smoke
-        workload."""
+        workload — plus the ISSUE 9 dense:sparse_knn restricted-count
+        preset, whose 'stream' is the two cocluster carries."""
         args = self._args(audit)
         for pair in audit.PAIRS:
             res = audit.audit_pair(pair, args)
             assert res["ok"], (pair, res["divergence"])
-            assert res["checkpoints"] >= 6  # every stage stamped
+            # stream presets stamp every stage; the restricted-count preset
+            # compares exactly the agree + union carries
+            min_ckpts = 2 if pair == "dense:sparse_knn" else 6
+            assert res["checkpoints"] >= min_ckpts
 
     def test_injected_bf16_localizes_pca(self, audit, capsys):
         """Acceptance: --inject bf16:pca exits 3 naming pca as the FIRST

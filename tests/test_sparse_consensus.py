@@ -1,0 +1,653 @@
+"""Sparse (kNN-restricted) consensus regime tests — ISSUE 9.
+
+The tentpole contract: the SparseCoclusterAccumulator's [n, m] agree/union
+counts are *integer-exactly* the dense accumulator's counts gathered at the
+candidate pairs (the restriction changes WHICH pairs are counted, never a
+count), the regime resolver auto-switches to sparse_knn above
+DENSE_CONSENSUS_LIMIT while leaving the dense default below it untouched,
+an explicitly dense regime above the limit fails loudly instead of OOMing,
+and the downstream consumers (consensus grid, small-cluster merge,
+dendrogram, serving stability) all run from the restricted counts — O(n·m)
+end to end. Satellites: the parity_audit dense:sparse_knn preset, the bench
+sparse_consensus rung (BENCH_r09.json pin), bench_diff rungs/alias, the
+report "== consensus ==" table, and the schema-registry coverage.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.cluster.knn import knn_candidates, knn_from_distance
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.cocluster import (
+    CoclusterAccumulator,
+    SparseCoclusterAccumulator,
+    _finalize_cocluster_distance,
+)
+from consensusclustr_tpu.consensus.merge import (
+    merge_small_clusters_from_pair_stats,
+    restricted_cluster_distance,
+    restricted_pair_stats,
+    stability_from_restricted_counts,
+)
+from consensusclustr_tpu.consensus.pipeline import (
+    CANDIDATE_M_ATTR,
+    CONSENSUS_REGIMES,
+    PAIRS_ATTR,
+    PAIRS_RATIO_ATTR,
+    REGIME_ATTR,
+    consensus_cluster,
+    dense_consensus_limit,
+    resolve_candidate_m,
+    resolve_consensus_regime,
+    run_bootstraps,
+)
+from consensusclustr_tpu.obs import Tracer
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import root_key
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pca(n=120, d=8, pops=4, seed=0):
+    r = np.random.default_rng(seed)
+    centers = r.normal(0.0, 6.0, size=(pops, d))
+    return (
+        centers[r.integers(0, pops, size=n)] + r.normal(0, 1.0, size=(n, d))
+    ).astype(np.float32)
+
+
+def _restricted(full, cand):
+    return np.take_along_axis(np.asarray(full), np.asarray(cand), axis=1)
+
+
+# -----------------------------------------------------------------------------
+# restricted-count integer parity vs dense
+# -----------------------------------------------------------------------------
+
+
+class TestRestrictedCountParity:
+    @pytest.mark.parametrize(
+        "mode,cluster_fun",
+        [
+            ("robust", "leiden"),
+            ("robust", "louvain"),
+            ("granular", "leiden"),
+            ("granular", "louvain"),
+        ],
+    )
+    def test_integer_exact_vs_dense(self, mode, cluster_fun):
+        """The tentpole contract, across robust/granular x leiden/louvain:
+        on candidate pairs the sparse counts ARE the dense counts."""
+        pca = _pca(n=110)
+        n = pca.shape[0]
+        cfg = ClusterConfig(
+            nboots=4, mode=mode, cluster_fun=cluster_fun, k_num=(6,),
+            res_range=(0.3, 0.8),
+        )
+        labels, _ = run_bootstraps(root_key(3), jnp.asarray(pca), cfg)
+        labels = jnp.asarray(np.asarray(labels).reshape(-1, n), jnp.int32)
+
+        dense = CoclusterAccumulator(n, cfg.max_clusters)
+        dense.update(labels)
+        cand = knn_candidates(jnp.asarray(pca), 20)
+        sparse = SparseCoclusterAccumulator(cand)
+        sparse.update(labels)
+
+        agree_d, union_d = (np.asarray(a) for a in dense.carries())
+        agree_s, union_s = (np.asarray(a) for a in sparse.carries())
+        assert np.array_equal(_restricted(agree_d, cand), agree_s)
+        assert np.array_equal(_restricted(union_d, cand), union_s)
+        # counts are integers in f32 — the exactness precondition
+        assert np.array_equal(agree_s, np.round(agree_s))
+        assert np.array_equal(union_s, np.round(union_s))
+
+    def test_chunked_streaming_is_order_exact(self):
+        """Any chunking of the boot axis yields bit-identical carries (the
+        same integer-count argument as the dense accumulator)."""
+        r = np.random.default_rng(5)
+        n, b, m = 60, 12, 10
+        labels = r.integers(-1, 5, size=(b, n)).astype(np.int32)
+        cand = knn_candidates(jnp.asarray(_pca(n=n, seed=5)), m)
+        one = SparseCoclusterAccumulator(cand)
+        one.update(jnp.asarray(labels))
+        many = SparseCoclusterAccumulator(cand)
+        for s in range(0, b, 5):  # ragged tail on purpose
+            many.update(jnp.asarray(labels[s:s + 5]))
+        a1, u1 = (np.asarray(x) for x in one.carries())
+        a2, u2 = (np.asarray(x) for x in many.carries())
+        assert np.array_equal(a1, a2)
+        assert np.array_equal(u1, u2)
+        assert many.rows == b and many.chunks == 3
+
+    def test_distances_match_dense_on_candidates(self):
+        """Finalized restricted distances equal the dense matrix gathered at
+        the candidate pairs — including never-co-sampled pairs (union 0 ->
+        distance 1, the shared deviation)."""
+        r = np.random.default_rng(9)
+        n, m = 40, 8
+        # plant a column pair that is never co-sampled
+        labels = r.integers(0, 3, size=(6, n)).astype(np.int32)
+        labels[:3, 0] = -1
+        labels[3:, 1] = -1
+        cand = knn_candidates(jnp.asarray(_pca(n=n, seed=9)), m)
+        dense = CoclusterAccumulator(n)
+        dense.update(jnp.asarray(labels))
+        sparse = SparseCoclusterAccumulator(cand)
+        sparse.update(jnp.asarray(labels))
+        dist_dense = np.asarray(
+            _finalize_cocluster_distance(*dense.carries())
+        )
+        got = np.asarray(sparse.distances())
+        want = _restricted(dist_dense, cand)
+        # candidates exclude self, so the dense diagonal-zero repair never
+        # lands in the gathered view — exact equality holds
+        assert np.array_equal(want, got)
+
+    def test_consensus_knn_graph_from_restricted_counts(self):
+        """consensus_knn returns (idx, dist) sorted by increasing restricted
+        distance, idx drawn from each row's candidate set — and when the
+        dense top-k is unambiguous (no ties), it matches knn_from_distance
+        on the dense matrix restricted to candidates."""
+        r = np.random.default_rng(2)
+        n, m, k = 50, 12, 4
+        labels = r.integers(0, 4, size=(16, n)).astype(np.int32)
+        cand = knn_candidates(jnp.asarray(_pca(n=n, seed=2)), m)
+        sparse = SparseCoclusterAccumulator(cand)
+        sparse.update(jnp.asarray(labels))
+        idx, dist = (np.asarray(a) for a in sparse.consensus_knn(k))
+        assert idx.shape == (n, k) and dist.shape == (n, k)
+        assert np.all(np.diff(dist, axis=1) >= 0)  # increasing distance
+        cand_np = np.asarray(cand)
+        for i in range(n):
+            assert set(idx[i]).issubset(set(cand_np[i]))
+        # per row, the k smallest restricted distances are exactly the k
+        # smallest gathered dense distances (multiset equality — tie ORDER
+        # may differ from the dense path's column-index tie-break)
+        dense = CoclusterAccumulator(n)
+        dense.update(jnp.asarray(labels))
+        gathered = _restricted(
+            np.asarray(_finalize_cocluster_distance(*dense.carries())), cand
+        )
+        want = np.sort(gathered, axis=1)[:, :k]
+        assert np.allclose(np.sort(dist, axis=1), want)
+
+    def test_linear_memory_footprint(self):
+        """The deterministic O(n·m) memory model: carries are exactly
+        2 x [n, m] f32 — doubling n doubles the footprint (the dense
+        accumulator's quadruples)."""
+        sizes = {}
+        for n in (64, 128):
+            acc = SparseCoclusterAccumulator(
+                knn_candidates(jnp.asarray(_pca(n=n)), 16)
+            )
+            a, u = acc.carries()
+            sizes[n] = a.nbytes + u.nbytes
+            assert sizes[n] == 2 * n * 16 * 4
+        assert sizes[128] == 2 * sizes[64]
+
+    def test_update_validates_shape(self):
+        acc = SparseCoclusterAccumulator(
+            knn_candidates(jnp.asarray(_pca(n=32)), 8)
+        )
+        with pytest.raises(ValueError, match="incompatible"):
+            acc.update(jnp.zeros((3, 31), jnp.int32))
+        with pytest.raises(ValueError, match=r"\[n, m\]"):
+            SparseCoclusterAccumulator(jnp.zeros((4,), jnp.int32))
+
+
+# -----------------------------------------------------------------------------
+# regime resolution + the dense footgun guard
+# -----------------------------------------------------------------------------
+
+
+class TestRegimeResolution:
+    def test_auto_below_limit_is_dense(self):
+        assert resolve_consensus_regime(ClusterConfig(), 500) == "dense"
+
+    def test_auto_above_limit_is_sparse(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_DENSE_CONSENSUS_LIMIT", "64")
+        assert dense_consensus_limit() == 64
+        assert resolve_consensus_regime(ClusterConfig(), 100) == "sparse_knn"
+        assert resolve_consensus_regime(ClusterConfig(), 64) == "dense"
+
+    def test_legacy_bool_mapping(self):
+        assert (
+            resolve_consensus_regime(ClusterConfig(dense_consensus=True), 50)
+            == "dense"
+        )
+        assert (
+            resolve_consensus_regime(ClusterConfig(dense_consensus=False), 50)
+            == "blockwise"
+        )
+
+    def test_explicit_regime_wins_over_legacy_bool(self):
+        cfg = ClusterConfig(
+            consensus_regime="sparse_knn", dense_consensus=True
+        )
+        assert resolve_consensus_regime(cfg, 50) == "sparse_knn"
+
+    def test_explicit_dense_above_limit_raises_loudly(self, monkeypatch):
+        """The ISSUE 9 footgun fix: no silent [n, n] materialization — the
+        error names the override that lets a caller force it anyway."""
+        monkeypatch.setenv("CCTPU_DENSE_CONSENSUS_LIMIT", "64")
+        for cfg in (
+            ClusterConfig(consensus_regime="dense"),
+            ClusterConfig(consensus_regime="pallas"),
+            ClusterConfig(dense_consensus=True),
+        ):
+            with pytest.raises(ValueError) as err:
+                resolve_consensus_regime(cfg, 100)
+            msg = str(err.value)
+            assert "CCTPU_DENSE_CONSENSUS_LIMIT" in msg
+            assert "sparse_knn" in msg
+        # raising the named override unblocks the dense path
+        monkeypatch.setenv("CCTPU_DENSE_CONSENSUS_LIMIT", "128")
+        assert (
+            resolve_consensus_regime(ClusterConfig(dense_consensus=True), 100)
+            == "dense"
+        )
+
+    def test_config_validates_regime_and_candidates(self):
+        with pytest.raises(ValueError, match="consensus_regime"):
+            ClusterConfig(consensus_regime="bogus")
+        with pytest.raises(ValueError, match="sparse_knn_candidates"):
+            ClusterConfig(sparse_knn_candidates=1)
+        for regime in CONSENSUS_REGIMES:
+            ClusterConfig(consensus_regime=regime)  # all legal
+
+    def test_resolve_candidate_m(self):
+        cfg = ClusterConfig(k_num=(10, 15, 20))
+        assert resolve_candidate_m(cfg, 10_000, cfg.k_num) == 64
+        assert resolve_candidate_m(cfg.replace(k_num=(40,)), 10_000, (40,)) == 80
+        # explicit width honored, but never below max(k) nor above n - 1
+        cfg2 = cfg.replace(sparse_knn_candidates=8)
+        assert resolve_candidate_m(cfg2, 10_000, cfg.k_num) == 20
+        assert resolve_candidate_m(cfg, 50, cfg.k_num) == 49
+
+
+# -----------------------------------------------------------------------------
+# end-to-end sparse regime through consensus_cluster
+# -----------------------------------------------------------------------------
+
+
+class TestSparseEndToEnd:
+    def _run(self, pca, cfg, tracer=None):
+        log = LevelLog(tracer=tracer) if tracer is not None else None
+        return consensus_cluster(root_key(7), jnp.asarray(pca), cfg, log=log)
+
+    def test_sparse_regime_result_and_spans(self):
+        pca = _pca(n=110)
+        cfg = ClusterConfig(
+            nboots=4, k_num=(6,), res_range=(0.3, 0.8),
+            consensus_regime="sparse_knn", sparse_knn_candidates=20,
+        )
+        tracer = Tracer()
+        res = self._run(pca, cfg, tracer)
+        assert res.regime == "sparse_knn"
+        assert res.jaccard_dist is None
+        assert res.sparse is not None and res.sparse.m == 20
+        assert res.sparse.agree.shape == (110, 20)
+        assert res.n_clusters >= 2  # 4 planted populations
+        # the cocluster span carries the regime provenance attrs
+        attrs = {}
+        for root in tracer.roots:
+            for _, sp in root.walk():
+                if sp.name == "cocluster":
+                    attrs = sp.attrs
+        assert attrs[REGIME_ATTR] == "sparse_knn"
+        assert attrs[CANDIDATE_M_ATTR] == 20
+        assert attrs[PAIRS_ATTR] == 110 * 20
+        assert 0.0 < attrs[PAIRS_RATIO_ATTR] < 1.0
+        assert any(
+            sp.name == "candidates"
+            for root in tracer.roots
+            for _, sp in root.walk()
+        )
+
+    def test_degenerate_n_le_m(self):
+        """n <= m: the candidate width clips to n - 1 and the regime still
+        runs (the padded-kNN duplicate-slot convention is count-exact)."""
+        pca = _pca(n=12, pops=2)
+        cfg = ClusterConfig(
+            nboots=3, k_num=(4,), res_range=(0.5,),
+            consensus_regime="sparse_knn", sparse_knn_candidates=64,
+        )
+        res = self._run(pca, cfg)
+        assert res.regime == "sparse_knn"
+        assert res.sparse.m == 11
+        assert len(res.labels) == 12
+
+    def test_auto_switch_end_to_end(self, monkeypatch):
+        """Above the (env-lowered) limit a default config lands on the
+        sparse regime without being asked."""
+        monkeypatch.setenv("CCTPU_DENSE_CONSENSUS_LIMIT", "64")
+        pca = _pca(n=110)
+        cfg = ClusterConfig(
+            nboots=4, k_num=(6,), res_range=(0.3, 0.8),
+            sparse_knn_candidates=20,
+        )
+        res = self._run(pca, cfg)
+        assert res.regime == "sparse_knn"
+        assert res.sparse is not None
+
+    def test_dense_default_below_limit_unchanged(self):
+        """The guard criterion's other half: below the threshold the default
+        regime is still dense with the full [n, n] matrix attached."""
+        pca = _pca(n=110)
+        cfg = ClusterConfig(nboots=4, k_num=(6,), res_range=(0.3, 0.8))
+        res = self._run(pca, cfg)
+        assert res.regime == "dense"
+        assert res.jaccard_dist is not None and res.sparse is None
+
+    def test_resume_through_sparse_carries(self, tmp_path):
+        """Checkpoint-resume feeds host rows through the same on_enqueue
+        hook: a fully resumed run reproduces labels AND restricted carries
+        bit-identically."""
+        pca = _pca(n=110)
+        cfg = ClusterConfig(
+            nboots=6, k_num=(6,), res_range=(0.3, 0.8),
+            consensus_regime="sparse_knn", sparse_knn_candidates=20,
+            checkpoint_dir=str(tmp_path), boot_batch=2,
+        )
+        cold = self._run(pca, cfg)
+        tracer = Tracer()
+        warm = self._run(pca, cfg, tracer)
+        assert tracer.metrics.counters["boots_resumed"].value == 6
+        assert np.array_equal(cold.labels, warm.labels)
+        assert np.array_equal(cold.sparse.agree, warm.sparse.agree)
+        assert np.array_equal(cold.sparse.union, warm.sparse.union)
+        assert np.array_equal(cold.sparse.cand_idx, warm.sparse.cand_idx)
+
+
+# -----------------------------------------------------------------------------
+# restricted merge statistics + stability diagonal
+# -----------------------------------------------------------------------------
+
+
+class TestRestrictedMergeAndStability:
+    def _fixture(self, n=40, m=6, c=3, seed=4):
+        r = np.random.default_rng(seed)
+        labels = r.integers(0, 4, size=(8, n)).astype(np.int32)
+        cand = np.asarray(knn_candidates(jnp.asarray(_pca(n=n, seed=seed)), m))
+        acc = SparseCoclusterAccumulator(jnp.asarray(cand))
+        acc.update(jnp.asarray(labels))
+        agree, union = (np.asarray(a) for a in acc.carries())
+        codes = r.integers(0, c, size=n).astype(np.int32)
+        return agree, union, cand, codes, c
+
+    def test_restricted_pair_stats_match_bruteforce(self):
+        agree, union, cand, codes, c = self._fixture()
+        sums, counts = (
+            np.asarray(a)
+            for a in restricted_pair_stats(
+                jnp.asarray(agree), jnp.asarray(union), jnp.asarray(cand),
+                jnp.asarray(codes), c,
+            )
+        )
+        bs = np.zeros((c, c))
+        bc = np.zeros((c, c))
+        dist = np.where(union > 0, 1.0 - agree / np.maximum(union, 1.0), 1.0)
+        n, m = cand.shape
+        for i in range(n):
+            for s in range(m):
+                j = cand[i, s]
+                bs[codes[i], codes[j]] += dist[i, s]
+                bc[codes[i], codes[j]] += 1.0
+        assert np.allclose(sums, bs, atol=1e-4)
+        assert np.array_equal(counts, bc)
+
+    def test_merge_folds_smallest_into_nearest(self):
+        # cluster 2 is tiny and (by construction) near cluster 0
+        sums = np.array([[0.0, 9.0, 0.2], [9.0, 0.0, 9.0], [0.2, 9.0, 0.0]])
+        pc = np.array([[4.0, 9.0, 1.0], [9.0, 4.0, 9.0], [1.0, 9.0, 1.0]])
+        labels = np.array([0] * 10 + [1] * 10 + [2] * 2, np.int32)
+        out = merge_small_clusters_from_pair_stats(sums, pc, labels, 5)
+        assert set(out.tolist()) == {0, 1}
+        assert np.all(out[-2:] == 0)
+
+    def test_isolated_cluster_folds_into_largest(self):
+        # cluster 2 has NO candidate edge into any other cluster
+        sums = np.zeros((3, 3))
+        pc = np.zeros((3, 3))
+        pc[0, 1] = pc[1, 0] = 5.0
+        labels = np.array([0] * 12 + [1] * 6 + [2] * 2, np.int32)
+        out = merge_small_clusters_from_pair_stats(sums, pc, labels, 4)
+        assert np.all(out[-2:] == 0)  # largest live cluster
+
+    def test_stability_diagonal_bounds_and_bruteforce(self):
+        agree, union, cand, codes, c = self._fixture(seed=6)
+        stab = stability_from_restricted_counts(agree, union, cand, codes, c)
+        assert stab.shape == (c,)
+        assert np.all((stab >= 0.0) & (stab <= 1.0))
+        jac = np.where(union > 0, agree / np.maximum(union, 1.0), 0.0)
+        for cl in range(c):
+            num = den = 0.0
+            n, m = cand.shape
+            for i in range(n):
+                for s in range(m):
+                    if (
+                        codes[i] == cl
+                        and codes[cand[i, s]] == cl
+                        and union[i, s] > 0
+                    ):
+                        num += jac[i, s]
+                        den += 1.0
+            want = num / den if den else 1.0
+            assert abs(float(stab[cl]) - want) < 1e-5
+
+    def test_stability_perfect_coclustering_is_one(self):
+        n, m = 20, 4
+        cand = np.asarray(knn_candidates(jnp.asarray(_pca(n=n, seed=1)), m))
+        agree = np.full((n, m), 7.0, np.float32)
+        union = np.full((n, m), 7.0, np.float32)
+        codes = np.zeros(n, np.int32)
+        stab = stability_from_restricted_counts(agree, union, cand, codes, 2)
+        assert float(stab[0]) == 1.0
+        assert float(stab[1]) == 1.0  # empty cluster: NaN -> 1 repair
+
+    def test_restricted_cluster_distance_shape_and_diag(self):
+        agree, union, cand, codes, c = self._fixture(seed=8)
+        cm = restricted_cluster_distance(agree, union, cand, codes, c)
+        assert cm.shape == (c, c)
+        assert np.all(np.diagonal(cm) == 0.0)
+        assert np.allclose(cm, cm.T)
+
+
+# -----------------------------------------------------------------------------
+# tooling surfaces: parity_audit, bench_diff, report, schema registry
+# -----------------------------------------------------------------------------
+
+
+class TestToolingSurfaces:
+    def test_parity_audit_sparse_preset_clean(self):
+        """Acceptance: --pair dense:sparse_knn exits 0 (integer-exact
+        restricted counts) on the CPU smoke workload."""
+        audit = _load_tool("parity_audit")
+        assert "dense:sparse_knn" in audit.PAIRS
+        rc = audit.main(["--pair", "dense:sparse_knn", "--cells", "64",
+                         "--genes", "32", "--boots", "3"])
+        assert rc == 0
+
+    def test_parity_audit_sparse_preset_refuses_inject(self, capsys):
+        audit = _load_tool("parity_audit")
+        rc = audit.main(
+            ["--pair", "dense:sparse_knn", "--inject", "bf16:pca"]
+        )
+        assert rc == 1
+        assert "does not apply" in capsys.readouterr().err
+
+    def test_audit_sparse_restricted_reports_divergence_fields(self):
+        """The custom handler's divergence record names the cocluster
+        checkpoint (the shape the generic reporter prints)."""
+        audit = _load_tool("parity_audit")
+        import argparse
+
+        args = argparse.Namespace(cells=64, genes=32, boots=3, pcs=3, seed=7)
+        res = audit.audit_sparse_restricted(args)
+        assert res["ok"] is True and res["divergence"] is None
+        assert res["checkpoints"] == 2
+        assert res["restricted_pairs"] > 0
+
+    def test_bench_diff_sparse_rungs_registered(self):
+        bd = _load_tool("bench_diff")
+        assert bd.RUNGS["sparse_consensus.cocluster_rss_peak_mb"] == -1
+        assert bd.RUNGS["sparse_consensus.peak_rss_mb"] == -1
+        assert bd.RUNGS["sparse_consensus.carry_mb"] == -1
+        assert bd.RUNGS["sparse_consensus.boots_per_sec"] == +1
+        assert (
+            bd.RUNG_ALIASES["sparse_rss"]
+            == "sparse_consensus.cocluster_rss_peak_mb"
+        )
+
+    def test_bench_diff_sparse_rss_gate(self, tmp_path):
+        bd = _load_tool("bench_diff")
+
+        def payload(rss):
+            return {
+                "metric": "m", "value": 1.0, "unit": "u", "obs_schema": 6,
+                "sparse_consensus": {"cocluster_rss_peak_mb": rss},
+            }
+
+        old = tmp_path / "BENCH_a.json"
+        new = tmp_path / "BENCH_b.json"
+        old.write_text(json.dumps(payload(100.0)))
+        new.write_text(json.dumps(payload(400.0)))  # 4x memory regression
+        rc = bd.main([str(old), str(new), "--gate", "sparse_rss:0.9"])
+        assert rc == 3
+        new.write_text(json.dumps(payload(101.0)))
+        assert bd.main([str(old), str(new), "--gate", "sparse_rss:0.9"]) == 0
+
+    def test_report_consensus_table(self):
+        report = _load_tool("report")
+        rec = {
+            "spans": [
+                {
+                    "name": "consensus",
+                    "children": [{
+                        "name": "cocluster",
+                        "attrs": {
+                            "consensus_regime": "sparse_knn",
+                            "candidate_m": 64,
+                            "accumulated_pairs": 262144,
+                            "pairs_ratio": 0.015625,
+                        },
+                    }],
+                }
+            ]
+        }
+        out = report.consensus(rec)
+        assert "sparse_knn" in out and "64" in out and "0.015625" in out
+        # legacy records: the dense bool still renders a regime name
+        legacy = {"spans": [{"name": "cocluster", "attrs": {"dense": True}}]}
+        assert "dense" in report.consensus(legacy)
+        # absent everything: placeholder, never a KeyError
+        assert "no consensus" in report.consensus({"spans": []})
+        assert "== consensus ==" in report.render({"spans": [], "events": []})
+
+    def test_schema_registry_both_ways(self):
+        from consensusclustr_tpu.consensus import pipeline as pl
+
+        attrs = {
+            pl.REGIME_ATTR, pl.CANDIDATE_M_ATTR, pl.PAIRS_ATTR,
+            pl.PAIRS_RATIO_ATTR,
+        }
+        assert attrs == set(obs_schema.CONSENSUS_SPAN_ATTRS)
+        assert "candidates" in obs_schema.SPAN_NAMES
+        check = _load_tool("check_obs_schema")
+        assert hasattr(check, "check_consensus_attrs")
+        assert check.check_consensus_attrs(REPO_ROOT) == []
+        assert check.check(REPO_ROOT) == []
+
+    def test_schema_check_catches_unregistered_consensus_attr(self, tmp_path):
+        """The broken direction: an unregistered *_ATTR literal in
+        consensus/pipeline.py fails the check."""
+        check = _load_tool("check_obs_schema")
+        pkg = tmp_path / "consensusclustr_tpu" / "consensus"
+        pkg.mkdir(parents=True)
+        (pkg / "pipeline.py").write_text(
+            'ROGUE_ATTR = "not_registered_anywhere"\n'
+        )
+        errors = check.check_consensus_attrs(str(tmp_path))
+        assert any("not_registered_anywhere" in e for e in errors)
+
+
+# -----------------------------------------------------------------------------
+# the committed bench rung
+# -----------------------------------------------------------------------------
+
+
+class TestBenchRung:
+    def _bench(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO_ROOT, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _committed(self):
+        path = os.path.join(REPO_ROOT, "BENCH_r09.json")
+        assert os.path.isfile(path), "BENCH_r09.json missing"
+        doc = json.load(open(path))
+        payload = doc.get("parsed") or doc
+        return payload
+
+    def test_bench_r09_schema_pin(self):
+        """The bench-rung schema pin: r09 carries the sparse_consensus block
+        on the SAME obs schema as the numerics PR (no bump — additive keys
+        only), so the r07 -> r09 committed pair stays an adjacent diff."""
+        payload = self._committed()
+        assert payload.get("obs_schema") == 6
+        sc = payload["sparse_consensus"]
+        # >= 8x the default CPU rung's 512 cells
+        assert sc["cells"] >= 8 * 512
+        assert sc["boots_per_sec"] > 0
+        assert sc["labels_fingerprint"]
+        assert sc["candidate_m"] >= 10
+        assert sc["cocluster_rss_peak_mb"] > 0
+
+    def test_bench_r09_subquadratic_memory(self):
+        """Acceptance: the consensus carries at the 8x rung are sub-quadratic
+        — the exact O(n·m) footprint is < 1/16 of the dense O(n²)
+        equivalent (it would be EQUAL if the restriction regressed)."""
+        sc = self._committed()["sparse_consensus"]
+        assert sc["carry_mb"] * 16 < sc["dense_equiv_mb"]
+        assert 0.0 < sc["pairs_ratio"] < 1.0 / 8.0
+
+    def test_zero_shape_matches_committed_keys(self):
+        """The failure rung stays key-comparable with a real rung."""
+        bench = self._bench()
+        sc = self._committed()["sparse_consensus"]
+        assert set(bench._SPARSE_CONSENSUS_ZERO) == set(sc)
+
+    def test_check_mode_accepts_committed_pair(self):
+        """bench_diff --check over the newest committed pair (r07 schema 5 ->
+        r09 schema 6) relaxes the adjacent bump and renders the sparse
+        rungs."""
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "bench_diff.py"),
+             "--check", "--dir", REPO_ROOT],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bench_diff: ok" in proc.stdout

@@ -18,6 +18,11 @@ Usage:
         # latency at the saturation step of the open-loop offered-rate
         # ladder (tools/loadgen.py); "rejections" likewise aliases
         # serve_rejection_rate
+    python tools/bench_diff.py OLD NEW --gate sparse_rss:0.8  # sparse memory
+        # gate: "sparse_rss" aliases sparse_consensus.cocluster_rss_peak_mb
+        # (lower is better) — the consensus phase's RSS watermark at the
+        # >= 8x-cells sparse rung; an O(n²) regression in the restricted
+        # accumulator shows up here first (ISSUE 9)
     python tools/bench_diff.py OLD NEW --gate parity          # label parity
         # gate: exact-match comparison of the per-rung labels_fingerprint
         # (obs schema v6, obs/fingerprint.py checksum of the rung's label
@@ -107,6 +112,16 @@ RUNGS: Dict[str, int] = {
     # under load and the shed fraction are both lower-is-better tail rungs
     "serving_p99_ms": -1,
     "serve_rejection_rate": -1,
+    # sparse-consensus rung (ISSUE 9): the kNN-restricted regime at >= 8x
+    # the default rung's cells. cocluster_rss_peak_mb is the consensus
+    # phase's own RSS watermark (the O1 sub-quadratic gate surface — this is
+    # what would explode O(n²) if the restriction regressed); carry_mb is
+    # the exact accumulator footprint (n*m*8 bytes, deterministic).
+    "sparse_consensus.boots_per_sec": +1,
+    "sparse_consensus.wall_s": -1,
+    "sparse_consensus.peak_rss_mb": -1,
+    "sparse_consensus.cocluster_rss_peak_mb": -1,
+    "sparse_consensus.carry_mb": -1,
 }
 
 # Gate-spec shorthands: --gate compiles:0.9 reads better than the full
@@ -119,6 +134,9 @@ RUNG_ALIASES: Dict[str, str] = {
     "flops": "est_flops",
     "p99": "serving_p99_ms",
     "rejections": "serve_rejection_rate",
+    # ISSUE 9: the sparse-consensus memory gate — the consensus phase's own
+    # RSS watermark at the >= 8x rung (sub-quadratic or bust)
+    "sparse_rss": "sparse_consensus.cocluster_rss_peak_mb",
 }
 
 _JSON_LINE = re.compile(r"^\{.*\}$")

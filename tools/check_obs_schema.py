@@ -38,6 +38,11 @@ trees, and ``tools/parity_audit.py``'s checkpoint/metric/event literals — a
 renamed checkpoint is a test failure, not a parity audit that silently
 stops covering a pipeline stage.
 
+Since ISSUE 9 the same both-directions treatment covers the consensus-regime
+provenance: ``consensus/pipeline.py``'s ``*_ATTR`` constants (the regime /
+candidate_m / accumulated_pairs / pairs_ratio attrs on the candidates and
+cocluster spans) <-> ``obs.schema.CONSENSUS_SPAN_ATTRS``.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -220,12 +225,26 @@ def check_numeric_registry(root: str) -> List[str]:
     return errors
 
 
+def check_consensus_attrs(root: str) -> List[str]:
+    """ISSUE 9: consensus/pipeline.py ``*_ATTR`` literals (the regime
+    provenance stamped on the candidates/cocluster spans) <->
+    schema.CONSENSUS_SPAN_ATTRS, both directions — a renamed regime attr is
+    a test failure, not a silently empty "== consensus ==" table in
+    tools/report.py."""
+    return _check_constant_registry(
+        root,
+        os.path.join("consensusclustr_tpu", "consensus", "pipeline.py"),
+        ATTR_RE, "CONSENSUS_SPAN_ATTRS", "span attr", require_complete=True,
+    )
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
         check_help_registry()
         + check_resource_attrs(root)
         + check_numeric_registry(root)
+        + check_consensus_attrs(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

@@ -36,6 +36,14 @@ Pair presets (regime A : regime B):
   x64:x32        jax_enable_x64 on vs off — the pipeline pins float32/int32
                  everywhere explicitly, so host-promotion differences must
                  not reach any checkpoint.
+  dense:sparse_knn
+                 the dense [n, n] count oracle vs the kNN-restricted sparse
+                 accumulator (ISSUE 9). Not a stream diff: one boot fan-out
+                 feeds both accumulators and the dense counts gathered at
+                 the candidate pairs must equal the sparse [n, m] carries
+                 integer-exactly. --inject does not apply to this pair
+                 (integer counts round-trip bf16 exactly at smoke scale, so
+                 a planted downgrade could never fire).
 
 Exit codes: 0 all pairs parity-clean; 1 usage/malformed; 3 divergence (the
 first divergent checkpoint is printed per pair and carried in the JSON
@@ -66,6 +74,15 @@ PAIRS: Dict[str, Tuple[dict, dict]] = {
     ),
     "depth1:depth4": ({"pipeline_depth": 1}, {"pipeline_depth": 4}),
     "x64:x32": ({"x64": True}, {"x64": False}),
+    # ISSUE 9: the dense [n, n] oracle vs the kNN-restricted sparse
+    # accumulator. NOT a stream diff (the cocluster carries legitimately
+    # differ in shape between regimes): one boot fan-out feeds BOTH
+    # accumulators, and the dense counts gathered at the candidate pairs
+    # must equal the sparse [n, m] counts integer-exactly — handled by
+    # audit_sparse_restricted below. --inject does not apply to this pair.
+    "dense:sparse_knn": (
+        {"consensus_regime": "dense"}, {"consensus_regime": "sparse_knn"}
+    ),
 }
 
 # Fingerprint fields whose mismatch counts as divergence. Stats (min/max/
@@ -183,8 +200,90 @@ def first_divergence(a: List[dict], b: List[dict]) -> Optional[dict]:
     return None
 
 
+def audit_sparse_restricted(args) -> dict:
+    """The ``dense:sparse_knn`` preset: restricted-count parity, not a
+    checkpoint-stream diff.
+
+    One seeded boot fan-out over the smoke workload's PCA geometry feeds
+    BOTH accumulators — the dense [n, n] CoclusterAccumulator and the
+    kNN-restricted [n, m] SparseCoclusterAccumulator over the same
+    candidate sets — and the dense agree/union counts *gathered at the
+    candidate pairs* must equal the sparse carries integer-exactly (the
+    ISSUE 9 restriction contract: the sparse regime changes WHICH pairs are
+    counted, never a single count). A mismatch reports the ``cocluster``
+    checkpoint with the offending field and pair tallies."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensusclustr_tpu.cluster.knn import knn_candidates
+    from consensusclustr_tpu.config import ClusterConfig
+    from consensusclustr_tpu.consensus.cocluster import (
+        CoclusterAccumulator,
+        SparseCoclusterAccumulator,
+    )
+    from consensusclustr_tpu.consensus.pipeline import (
+        resolve_candidate_m,
+        run_bootstraps,
+    )
+    from consensusclustr_tpu.utils.rng import root_key
+
+    counts = smoke_counts(args.cells, args.genes, args.seed)
+    # deterministic PCA geometry from the same workload (host SVD of the
+    # libsize-normalized log counts — the audit is about the accumulators,
+    # not the prep chain the stream presets already cover)
+    x = np.log1p(
+        counts / np.maximum(counts.sum(1, keepdims=True), 1.0) * 1e4
+    )
+    x = x - x.mean(0)
+    u, s, _ = np.linalg.svd(x, full_matrices=False)
+    pca = (u[:, : args.pcs] * s[: args.pcs]).astype(np.float32)
+    n = pca.shape[0]
+
+    cfg = ClusterConfig(
+        nboots=args.boots, k_num=(5,), res_range=(0.1, 0.5, 1.0),
+        test_significance=False, seed=args.seed,
+    )
+    labels, _ = run_bootstraps(root_key(args.seed), jnp.asarray(pca), cfg)
+    labels = jnp.asarray(np.asarray(labels).reshape(-1, n), jnp.int32)
+
+    dense = CoclusterAccumulator(n, cfg.max_clusters)
+    dense.update(labels)
+    m = resolve_candidate_m(cfg, n, cfg.k_num)
+    cand = knn_candidates(jnp.asarray(pca), m)
+    sparse = SparseCoclusterAccumulator(cand)
+    sparse.update(labels)
+
+    cand_np = np.asarray(cand)
+    agree_d, union_d = (np.asarray(a) for a in dense.carries())
+    agree_s, union_s = (np.asarray(a) for a in sparse.carries())
+    div = None
+    for field, full, restricted in (
+        ("agree", agree_d, agree_s), ("union", union_d, union_s),
+    ):
+        want = np.take_along_axis(full, cand_np, axis=1)
+        if not np.array_equal(want, restricted):
+            bad = int(np.sum(want != restricted))
+            div = {
+                "index": 0, "checkpoint": "cocluster", "occurrence": 0,
+                "field": field,
+                "a": f"dense[cand] ({bad} of {want.size} pairs differ)",
+                "b": "sparse carries",
+            }
+            break
+    return {
+        "pair": "dense:sparse_knn",
+        "checkpoints": 2,  # the agree + union carries
+        "candidate_m": m,
+        "restricted_pairs": int(n * m),
+        "divergence": div,
+        "ok": div is None,
+    }
+
+
 def audit_pair(pair: str, args, inject: Optional[str] = None) -> dict:
     """Run both regimes of ``pair`` on the shared workload and diff."""
+    if pair == "dense:sparse_knn":
+        return audit_sparse_restricted(args)
     spec_a, spec_b = PAIRS[pair]
     counts = smoke_counts(args.cells, args.genes, args.seed)
     stream_a = run_regime(spec_a, counts, args)
@@ -231,6 +330,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 1
+    if args.inject is not None and "dense:sparse_knn" in pairs:
+        if args.pair:  # explicitly requested: refuse loudly
+            print(
+                "parity_audit: --inject does not apply to dense:sparse_knn "
+                "(restricted-count diff, not a checkpoint-stream diff)",
+                file=sys.stderr,
+            )
+            return 1
+        # default all-presets run: the injection self-test covers the stream
+        # presets; the restricted-count pair is skipped rather than run
+        # without the planted downgrade (which would muddy the self-test)
+        pairs = [p for p in pairs if p != "dense:sparse_knn"]
     if args.inject is not None:
         from consensusclustr_tpu.obs.fingerprint import parse_inject
 

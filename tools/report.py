@@ -262,6 +262,45 @@ def dispatch(record: dict) -> str:
     return "\n".join(lines)
 
 
+def consensus(record: dict) -> str:
+    """Consensus-regime provenance table (ISSUE 9): which accumulator regime
+    assembled each consensus (the ``cocluster`` span's ``consensus_regime``
+    attr), the sparse regime's candidate width m, and the accumulated-pairs
+    vs n² ratio — the sub-quadratic evidence. Records written before the
+    regime attrs existed fall back to the legacy ``dense`` bool when
+    present, else render the placeholder line; every key access is guarded
+    (same contract as the serving/dispatch/memory tables)."""
+    lines: List[str] = []
+
+    def walk(span: dict) -> None:
+        attrs = span.get("attrs") or {}
+        if span.get("name") == "cocluster":
+            regime = attrs.get("consensus_regime")
+            if regime is None and "dense" in attrs:
+                regime = "dense" if attrs.get("dense") else "blockwise"
+            m = attrs.get("candidate_m")
+            pairs = attrs.get("accumulated_pairs")
+            ratio = attrs.get("pairs_ratio")
+            lines.append(
+                f"{str(regime or '?'):<12} "
+                f"{m if m is not None else '-':>12} "
+                f"{pairs if pairs is not None else '-':>16} "
+                f"{f'{ratio:.6f}' if ratio is not None else '-':>12}"
+            )
+        for child in span.get("children", []):
+            walk(child)
+
+    for s in record.get("spans", []):
+        walk(s)
+    if not lines:
+        return "(no consensus regime info)"
+    header = (
+        f"{'regime':<12} {'candidate m':>12} {'accum pairs':>16} "
+        f"{'pairs/n^2':>12}"
+    )
+    return "\n".join([header] + lines)
+
+
 def memory(record: dict) -> str:
     """Per-phase peak-memory attribution table (obs schema >= 4): spans
     stamped with ``rss_peak_bytes`` (and, when the backend reports memory,
@@ -386,6 +425,7 @@ def render(record: dict) -> str:
         "", "== span tree ==", flame(record),
         "", "== pipelining ==", pipelining(record),
         "", "== serving ==", serving(record),
+        "", "== consensus ==", consensus(record),
         "", "== dispatch ==", dispatch(record),
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),

@@ -367,6 +367,28 @@ def _serving_slo_rung() -> dict:
         return out
 
 
+def _resilience_counters(tracer=None) -> dict:
+    """Per-rung resilience telemetry (resilience/, ISSUE 10): retry and
+    quarantine counters from the rung's run-local registry — all zero on a
+    healthy run, non-zero when the rung survived transient faults (flaky
+    disk under the checkpoint writer, a wedged dispatch that recovered).
+    Guarded like the dispatch counters: the failure rung emits the zero
+    shape even when the package cannot import."""
+    names = (
+        "fault_injected", "retry_attempts", "retries_exhausted",
+        "ckpt_quarantined",
+    )
+    out = {k: 0 for k in names}
+    try:
+        counters = tracer.metrics.counters if tracer is not None else {}
+        for name in names:
+            if name in counters:
+                out[name] = int(counters[name].value)
+    except Exception:
+        pass
+    return {"resilience": out}
+
+
 def _labels_fingerprint(labels) -> "str | None":
     """Order-independent 64-bit checksum (obs/fingerprint.py) of a rung's
     label output — the per-rung parity surface ``tools/bench_diff.py
@@ -624,6 +646,7 @@ def _run_granular() -> dict:
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
+        **_resilience_counters(tracer),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
         "sparse_consensus": _sparse_consensus_rung(),
@@ -756,6 +779,7 @@ def _run() -> dict:
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
+        **_resilience_counters(tracer),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
         "sparse_consensus": _sparse_consensus_rung(),
@@ -956,6 +980,7 @@ def main() -> None:
             "phases": {},
             "pipeline_depth": _pipeline_depth(),
             "overlap_ratio": 0.0,
+            **_resilience_counters(),
             "serving": dict(_SERVING_ZERO),
             **{k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()},

@@ -882,10 +882,16 @@ def consensus_clust(
     from consensusclustr_tpu.obs.resource import start_for as _start_sampler
 
     sampler = _start_sampler(tracer, cfg.resource_sample_ms)
+    # Fault injection (resilience/inject.py, ISSUE 10): cfg.fault_inject
+    # plants a deterministic fault spec for exactly this run's duration;
+    # None is inert (env-planted CCTPU_FAULT_INJECT faults still apply).
+    from consensusclustr_tpu.resilience.inject import fault_scope
+
     try:
-        return _consensus_clust_run(
-            counts, norm_counts, pca, cfg, tracer, log, key, sampler
-        )
+        with fault_scope(cfg.fault_inject):
+            return _consensus_clust_run(
+                counts, norm_counts, pca, cfg, tracer, log, key, sampler
+            )
     finally:
         if sampler is not None:
             sampler.stop()

@@ -192,6 +192,19 @@ class ClusterConfig:
     # rss_peak_bytes/device_peak_bytes watermark attrs and the RunRecord
     # carries the sample series (rendered as Perfetto counter tracks).
     resource_sample_ms: Optional[int] = None
+    # Resilience (resilience/, ISSUE 10): total attempts per fault site —
+    # chunk dispatch, checkpoint read/write, serving warm-up/batch. None
+    # resolves CCTPU_RETRY_ATTEMPTS (default 3); 1 = fail-fast (no retries).
+    # Retried work is a pure function of its inputs, so results are
+    # bit-identical whether or not a retry fired (tools/chaos_audit.py).
+    retry_attempts: Optional[int] = None
+    # Deterministic fault injection (resilience/inject.py): a
+    # "<site>:<kind>[:<arg>]" spec planted for this run's duration — e.g.
+    # "boot_chunk:raise_once" or "ckpt_write:corrupt_bytes:64". None
+    # resolves CCTPU_FAULT_INJECT; unset = OFF, and the off path costs one
+    # dict lookup per site hit (docs/quirks.md). Sites are registered in
+    # obs/schema.py::FAULT_SITES; tools/chaos_audit.py drives the presets.
+    fault_inject: Optional[str] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -252,6 +265,18 @@ class ClusterConfig:
                 f"sparse_knn_candidates must be >= 2; got "
                 f"{self.sparse_knn_candidates}"
             )
+        if self.retry_attempts is not None and int(self.retry_attempts) < 1:
+            raise ValueError(
+                f"retry_attempts must be >= 1 (1 = fail-fast); got "
+                f"{self.retry_attempts}"
+            )
+        if self.fault_inject is not None:
+            # validate eagerly: a typo'd plant would otherwise "prove"
+            # resilience by never firing (resilience/inject.py raises on
+            # unknown sites/kinds; import is lazy + jax-free)
+            from consensusclustr_tpu.resilience.inject import parse_fault_spec
+
+            parse_fault_spec(self.fault_inject)
         if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
             raise ValueError(
                 f"resource_sample_ms must be >= 0 (0 = off); got "

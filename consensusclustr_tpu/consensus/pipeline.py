@@ -68,6 +68,15 @@ from consensusclustr_tpu.parallel.pipelined import (
     ChunkPipeline,
     pipeline_depth,
 )
+from consensusclustr_tpu.resilience.inject import (
+    BOOT_CHUNK_SITE,
+    CKPT_READ_SITE,
+    CKPT_WRITE_SITE,
+)
+from consensusclustr_tpu.resilience.retry import (
+    resolve_retry_policy,
+    retry_call,
+)
 from consensusclustr_tpu.utils.backend import default_backend as _default_backend
 from consensusclustr_tpu.utils.compile_cache import counting_jit
 from consensusclustr_tpu.utils.log import LevelLog
@@ -299,6 +308,12 @@ def run_bootstraps(
         n_k=len(k_list),
     )
 
+    mets = metrics_of(log)
+    # Bounded retries around every fault site this driver owns (ISSUE 10):
+    # chunk dispatch, checkpoint read, checkpoint write. Dispatch and load
+    # are pure functions of their inputs, so a retried chunk is bit-identical
+    # to a first-try one — the chaos audit (tools/chaos_audit.py) pins it.
+    rpol = resolve_retry_policy(cfg.retry_attempts)
     ckpt = None
     rows_per_boot = 1 if robust else len(k_list) * len(cfg.res_range)
     if cfg.checkpoint_dir:
@@ -333,11 +348,11 @@ def run_bootstraps(
             np.asarray(jax.random.key_data(key)).tobytes(),
         )
         ckpt = BootCheckpoint(
-            cfg.checkpoint_dir, fp, cfg.nboots, n, rows_per_boot=rows_per_boot
+            cfg.checkpoint_dir, fp, cfg.nboots, n,
+            rows_per_boot=rows_per_boot, metrics=mets, log=log,
         )
 
     keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
-    mets = metrics_of(log)
     depth = pipeline_depth(cfg.pipeline_depth)
     # one-time upload: the per-chunk jnp.asarray this replaces re-staged the
     # [n, d] matrix on every iteration when a caller passed a host array
@@ -361,7 +376,31 @@ def run_bootstraps(
     pipe = ChunkPipeline(
         depth, metrics=mets,
         on_enqueue=_feed_accumulator if accumulator is not None else None,
+        site=BOOT_CHUNK_SITE, retry=rpol, log=log,
     )
+
+    def _save_chunk(s2: int, labels2, scores2) -> None:
+        # checkpoint write under the retry policy (runs on the writer thread
+        # at depth > 1); exhaustion latches into the writer and fails the run
+        # within one chunk, exactly as an unretried write error did
+        retry_call(
+            lambda: ckpt.save_chunk(s2, labels2, scores2),
+            site=CKPT_WRITE_SITE, policy=rpol, metrics=mets, log=log,
+        )
+
+    def _load_chunk(s2: int, size: int):
+        # checkpoint read under the retry policy. A chunk that stays
+        # unreadable after the last attempt is treated as MISSING (the
+        # checkpoint is a cache — recomputing is always correct, dying on a
+        # bad cache never is); retry_call already counted retries_exhausted
+        # and emitted the event naming the site.
+        try:
+            return retry_call(
+                lambda: ckpt.load_chunk(s2, size),
+                site=CKPT_READ_SITE, policy=rpol, metrics=mets, log=log,
+            )
+        except Exception:
+            return None
 
     def _consume(ent):
         s, e = ent.meta
@@ -400,9 +439,9 @@ def run_bootstraps(
         if ckpt is not None:
             payload = (s, labels_np.reshape(-1, n), scores_np.reshape(-1))
             if writer is not None:
-                writer.submit(ckpt.save_chunk, *payload)
+                writer.submit(_save_chunk, *payload)
             else:
-                ckpt.save_chunk(*payload)
+                _save_chunk(*payload)
         if log:
             log.event("boots", done=e, total=cfg.nboots)
 
@@ -413,7 +452,7 @@ def run_bootstraps(
             for s in range(0, cfg.nboots, chunk):
                 e = min(s + chunk, cfg.nboots)
                 if ckpt is not None:
-                    cached = ckpt.load_chunk(s, e - s)
+                    cached = _load_chunk(s, e - s)
                     if cached is not None:
                         pipe.put_ready(s, cached, meta=(s, e))
                         continue
@@ -423,13 +462,22 @@ def run_bootstraps(
                 # boot grids (:394-395 vs :650's minSize=0 default) — the 0.15
                 # floor is inert here and only bites in the null sims
                 # (minSize=5).
-                chunk_dev = _boot_batch(
-                    keys[s:e], idx[s:e], pca_dev, res_list, k_list,
-                    jnp.float32(0.0),
-                    len(cfg.res_range), cfg.max_clusters, DEFAULT_COMMUNITY_ITERS,
-                    robust, n, cfg.cluster_fun, cfg.compute_dtype,
+                # grid_impl is passed explicitly (it was resolved above but
+                # dropped before ISSUE 10, so CCTPU_GRID_IMPL=looped silently
+                # kept running the fused program — the fused:looped parity
+                # pair now actually flips the implementation)
+                pipe.dispatch(
+                    s,
+                    lambda s=s, e=e: _boot_batch(
+                        keys[s:e], idx[s:e], pca_dev, res_list, k_list,
+                        jnp.float32(0.0),
+                        len(cfg.res_range), cfg.max_clusters,
+                        DEFAULT_COMMUNITY_ITERS,
+                        robust, n, cfg.cluster_fun, cfg.compute_dtype,
+                        grid_impl,
+                    ),
+                    meta=(s, e),
                 )
-                pipe.put(s, chunk_dev, meta=(s, e))
             for ent in pipe.drain():
                 _consume(ent)
         except BaseException:

@@ -38,6 +38,8 @@ from consensusclustr_tpu.prep.sizefactors import (
 )
 from consensusclustr_tpu.obs import maybe_span, metrics_of
 from consensusclustr_tpu.parallel.pipelined import ChunkPipeline, pipeline_depth
+from consensusclustr_tpu.resilience.inject import NULL_CHUNK_SITE
+from consensusclustr_tpu.resilience.retry import resolve_retry_policy
 from consensusclustr_tpu.prep.transform import shifted_log
 from consensusclustr_tpu.utils.compile_cache import counting_jit
 from consensusclustr_tpu.utils.rng import sim_key
@@ -144,7 +146,13 @@ def generate_null_statistics(
     keys = jax.vmap(lambda s: sim_key(key, s, round_id))(jnp.arange(n_sims))
     depth = pipeline_depth(pipeline_depth_override)
     mets = metrics_of(log)
-    pipe = ChunkPipeline(depth, metrics=mets)
+    # null-chunk dispatch is a fault site (ISSUE 10): transient chunk
+    # failures re-dispatch under the bounded retry policy; same keys, same
+    # chunk shape -> bit-identical stats on the retried attempt
+    pipe = ChunkPipeline(
+        depth, metrics=mets,
+        site=NULL_CHUNK_SITE, retry=resolve_retry_policy(), log=log,
+    )
     out = []
 
     def _consume(ent):
@@ -175,12 +183,15 @@ def generate_null_statistics(
                 e = min(s + chunk, n_sims)
                 for ent in pipe.ready_for_dispatch():
                     _consume(ent)
-                stats_dev = _null_stat_batch(
-                    keys[s:e], model, cov, res_list,
-                    int(n_cells), int(pc_num), k_list, pool_sizes,
-                    int(max_clusters), has_cov, cluster_fun, compute_dtype,
+                pipe.dispatch(
+                    s,
+                    lambda s=s, e=e: _null_stat_batch(
+                        keys[s:e], model, cov, res_list,
+                        int(n_cells), int(pc_num), k_list, pool_sizes,
+                        int(max_clusters), has_cov, cluster_fun, compute_dtype,
+                    ),
+                    meta=(s, e),
                 )
-                pipe.put(s, stats_dev, meta=(s, e))
             for ent in pipe.drain():
                 _consume(ent)
         except BaseException:

@@ -62,6 +62,11 @@ from __future__ import annotations
 # serve/ names landing inside v1), no registered name changed meaning and
 # the RunRecord grew no field. See docs/quirks.md "Consensus regimes and
 # the sparse_knn auto-switch".
+# ISSUE 10 (resilience) is additive too — no bump: the FAULT_SITES registry
+# below, the retry/quarantine/supervision counters and events, and the
+# ``retry_backoff_seconds`` histogram are new names with no change to any
+# existing one; the RunRecord layout is untouched. See docs/quirks.md
+# "Fault injection, retries and checkpoint integrity".
 SCHEMA_VERSION = 6
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
@@ -104,6 +109,14 @@ EVENT_KINDS = frozenset({
     # obs/fingerprint.py (ISSUE 8)
     "numeric_fingerprint",   # one audit-mode checkpoint fingerprint
     "numerics_nonfinite",    # watchdog: NaN/Inf observed at a checkpoint
+    # resilience/ (ISSUE 10)
+    "retry",                 # one retried attempt at a fault site (site,
+                             # attempt, error, backoff_s attrs)
+    "retries_exhausted",     # a site gave up; the original exception follows
+    "ckpt_quarantined",      # a corrupt/unreadable checkpoint chunk was
+                             # renamed aside and will be recomputed
+    "serve_worker_restart",  # the serving worker died unexpectedly and the
+                             # supervisor restarted it
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -165,7 +178,7 @@ METRIC_HELP = {
     "queue_depth": "gauge: request-queue occupancy at last submit/dequeue",
     "batch_occupancy": "gauge: rows/bucket fill of the last micro-batch",
     "serve_compile": "counter: bucket-shape first dispatches (XLA compiles)",
-    "serve_rejections": "counter: queue-full backpressure rejections",
+    "serve_rejections": "counter: queue-full backpressure rejections (each RetryableRejection carries a retry_after_s hint from the observed drain rate)",
     "compile_cache_enable_calls": "counter: enable_persistent_cache invocations (idempotency telemetry)",
     # dispatch/compile accounting (utils/compile_cache.counting_jit, ISSUE 5)
     "device_dispatches": "counter: top-level pipeline executable launches (counting_jit-wrapped entry programs)",
@@ -184,6 +197,13 @@ METRIC_HELP = {
     # numerics observability (obs/fingerprint.py, ISSUE 8)
     "numerics_nonfinite": "counter: NaN/Inf values observed at numeric checkpoints (watch/audit watchdog)",
     "numerics_checkpoints": "counter: numeric checkpoint fingerprints recorded (audit mode)",
+    # resilience layer (resilience/, ISSUE 10)
+    "fault_injected": "counter: deliberately planted faults that fired (CCTPU_FAULT_INJECT; always 0 in production)",
+    "retry_attempts": "counter: fault-site attempts retried after a failure (resilience/retry.py)",
+    "retries_exhausted": "counter: fault-site calls that gave up after the last attempt (the original exception surfaced)",
+    "retry_backoff_seconds": "histogram: per retried attempt, the backoff slept before it (capped exponential + seeded jitter)",
+    "ckpt_quarantined": "counter: checkpoint chunks renamed aside as corrupt/unreadable at resume (recomputed, not resumed)",
+    "serve_worker_restarts": "counter: serving worker threads restarted by the supervisor after an unexpected death",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -221,6 +241,25 @@ NUMERIC_CHECKPOINTS = frozenset({
 NUMERIC_SPAN_ATTRS = frozenset({
     "fingerprints",          # audit: {checkpoint: checksum} on the open span
     "numerics_nonfinite",    # watchdog: NaN/Inf count tagged on the span
+})
+
+# Named fault sites (ISSUE 10): the points where resilience/inject.py can
+# plant a deterministic failure (CCTPU_FAULT_INJECT=<site>:<kind>[:<arg>])
+# and resilience/retry.py wraps the work in the bounded-backoff policy.
+# tools/check_obs_schema.py validates the ``*_SITE`` literals in
+# resilience/inject.py against this set, both directions, and that every
+# site literal tools/chaos_audit.py names is registered — a renamed site is
+# a test failure, not a chaos audit that silently stops covering a failure
+# mode.
+FAULT_SITES = frozenset({
+    "boot_chunk",     # bootstrap chunk dispatch (consensus/pipeline.py)
+    "ckpt_write",     # checkpoint chunk save (utils/checkpoint.py; also the
+                      # corrupt_bytes target — silent on-disk corruption)
+    "ckpt_read",      # checkpoint chunk load at resume
+    "null_chunk",     # null-simulation chunk dispatch (nulltest/null.py)
+    "serve_batch",    # micro-batch device execution (serve/service.py)
+    "serve_warmup",   # per-bucket warm-up compile dispatch
+    "serve_worker",   # the serving worker loop itself (supervised restart)
 })
 
 # Span attrs stamped by consensus/pipeline.py on the candidates/cocluster

@@ -203,18 +203,33 @@ class ChunkPipeline:
         depth: int,
         metrics: Optional[MetricsRegistry] = None,
         on_enqueue: Optional[Callable[["PendingChunk"], None]] = None,
+        site: Optional[str] = None,
+        retry: Optional[Any] = None,
+        log: Any = None,
     ):
         """``on_enqueue``, when given, runs synchronously for every entry the
         moment it joins the window (``put`` AND ``put_ready``) — the hook the
         chunk drivers use to chain follow-on device work (e.g. the donated
         co-clustering accumulator) onto a chunk right at dispatch, while the
         chunk itself is still executing. The hook sees the entry before any
-        fetch: use ``ent.peek()`` for the raw payload."""
+        fetch: use ``ent.peek()`` for the raw payload.
+
+        ``site``/``retry``/``log`` (ISSUE 10): a fault-site name from
+        obs.schema.FAULT_SITES plus a resilience.retry.RetryPolicy turn
+        :meth:`dispatch` into a retried dispatch — a transient chunk failure
+        (injected or real) re-dispatches under the bounded-backoff policy
+        instead of draining the whole run. Dispatch is a pure function of
+        the chunk inputs, so a retried chunk is bit-identical to a
+        first-try one. With ``site=None`` dispatch degenerates to
+        ``put(index, thunk())`` exactly."""
         self.depth = int(depth)
         if self.depth < 1:
             raise ValueError(f"pipeline depth must be >= 1; got {self.depth}")
         self._metrics = metrics
         self._on_enqueue = on_enqueue
+        self._site = site
+        self._retry = retry
+        self._log = log
         self._window: "deque[PendingChunk]" = deque()
         self._inflight = 0
         self.max_inflight = 0
@@ -250,6 +265,24 @@ class ChunkPipeline:
         if self._on_enqueue is not None:
             self._on_enqueue(ent)
         return ent
+
+    def dispatch(self, index: int, thunk: Callable[[], Any], meta: Any = None) -> PendingChunk:
+        """Dispatch one chunk through the retry policy: ``thunk()`` runs the
+        (async) jitted call and its result joins the window via ``put``.
+        When the pipeline carries a fault ``site``, each attempt first runs
+        the site's injection check and a host-side dispatch failure is
+        retried under the policy (resilience/retry.py) — exhaustion
+        surfaces the original exception, preserving the drain semantics of
+        the driver's except/abort path."""
+        if self._site is None:
+            return self.put(index, thunk(), meta)
+        from consensusclustr_tpu.resilience.retry import retry_call
+
+        payload = retry_call(
+            thunk, site=self._site, policy=self._retry,
+            metrics=self._metrics, log=self._log,
+        )
+        return self.put(index, payload, meta)
 
     def put_ready(self, index: int, value: Any, meta: Any = None) -> PendingChunk:
         """Enqueue a host-ready value (resume cache) in chunk order."""

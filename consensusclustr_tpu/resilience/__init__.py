@@ -1,0 +1,28 @@
+"""Resilience layer (ISSUE 10): fault injection, retry policy, recovery.
+
+PR 8 built the *values* axis of trust (numeric fingerprints, regime-parity
+audits); this package builds the *failures* axis — the same "auditable, not
+eyeballed" contract applied to crashes. Named fault sites
+(``obs/schema.py::FAULT_SITES``) can plant deterministic, seeded failures
+under the opt-in ``CCTPU_FAULT_INJECT`` hook (off by default, zero-cost when
+off, exactly like numerics), a bounded retry policy with deterministic
+backoff wraps every site, and ``tools/chaos_audit.py`` proves that a run
+which survived injected faults produces bit-identical labels to a clean run.
+"""
+
+from consensusclustr_tpu.resilience.inject import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+    active_injector,
+    clear_fault,
+    fault_scope,
+    install_fault,
+    maybe_corrupt_file,
+    maybe_fail,
+    parse_fault_spec,
+)
+from consensusclustr_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    resolve_retry_policy,
+    retry_call,
+)

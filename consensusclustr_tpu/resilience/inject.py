@@ -1,0 +1,316 @@
+"""Fault-site registry + deterministic fault injection (ISSUE 10 tentpole).
+
+Mirrors ``obs/fingerprint.py``'s inject pattern: named sites are registered
+in ``obs/schema.py::FAULT_SITES`` (tools/check_obs_schema.py validates every
+``*_SITE`` literal here against the registry, both directions), and faults
+are planted through an opt-in hook that is OFF by default and costs one dict
+lookup when off — the default path stays dispatch- and wall-identical to a
+build without the layer (pinned in tests/test_resilience.py, the same
+off-is-free contract numerics established).
+
+The hook: ``CCTPU_FAULT_INJECT=<site>:<kind>[:<arg>]`` (env) or
+``ClusterConfig.fault_inject`` / :func:`install_fault` (explicit, beats the
+env). Multiple plants separate with ``;``. Kinds (hyphens and underscores
+both accepted):
+
+  * ``raise_once``        — raise :class:`InjectedFault` on the first hit of
+    the site, succeed forever after (the canonical *transient* fault).
+  * ``raise_first_n:N``   — raise on the first N hits.
+  * ``raise_always``      — raise on every hit (the *permanent* fault: the
+    retry policy must exhaust and surface it).
+  * ``flaky_p:P[@SEED]``  — raise with probability P per hit, drawn from a
+    seeded ``random.Random`` stream (deterministic sequence per injector).
+  * ``corrupt_bytes[:N]`` — for checkpoint-file sites only: after the first
+    atomic write completes (sidecar checksum included), overwrite N bytes
+    (default 64) of the final file with seeded garbage — simulating silent
+    on-disk corruption that the sha256 sidecar must catch at resume
+    (quarantine + recompute, utils/checkpoint.py). Never raises at the site.
+
+Raise kinds fire inside ``resilience/retry.py::retry_call`` — exactly once
+per attempt, before the wrapped work — so a planted transient fault consumes
+attempt 1 and the retry recovers; ``corrupt_bytes`` fires through
+:func:`maybe_corrupt_file` at the write site's implementation. Every firing
+increments the ``fault_injected`` counter.
+
+Import-light: no jax, no numpy — config validation and the checkpoint layer
+import this module without touching a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+from typing import Dict, Iterator, Optional
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry, global_metrics
+from consensusclustr_tpu.obs.schema import FAULT_SITES
+
+# Site-name constants (tools/check_obs_schema.py validates every ``*_SITE``
+# literal here against obs.schema.FAULT_SITES, both directions — call sites
+# import these, so a rename cannot silently orphan a fault site).
+BOOT_CHUNK_SITE = "boot_chunk"        # bootstrap chunk dispatch (consensus/pipeline.py)
+CKPT_WRITE_SITE = "ckpt_write"        # checkpoint chunk save (utils/checkpoint.py)
+CKPT_READ_SITE = "ckpt_read"          # checkpoint chunk load / resume
+NULL_CHUNK_SITE = "null_chunk"        # null-simulation chunk dispatch (nulltest/null.py)
+SERVE_BATCH_SITE = "serve_batch"      # micro-batch device execution (serve/service.py)
+SERVE_WARMUP_SITE = "serve_warmup"    # per-bucket warm-up compile dispatch
+SERVE_WORKER_SITE = "serve_worker"    # the serving worker loop itself (supervised restart)
+
+FAULT_KINDS = (
+    "raise_once", "raise_first_n", "raise_always", "flaky_p", "corrupt_bytes",
+)
+
+DEFAULT_CORRUPT_BYTES = 64
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately planted failure (never raised unless a fault was
+    installed). Carries the site so retry events and tests can localize."""
+
+    def __init__(self, message: str, site: str) -> None:
+        super().__init__(message)
+        self.site = site
+
+
+class _Plant:
+    """One planted fault's mutable state (hits / fires / RNG stream)."""
+
+    __slots__ = ("site", "kind", "n", "p", "rng", "calls", "fires")
+
+    def __init__(self, site: str, kind: str, n: int, p: float, seed: int) -> None:
+        self.site = site
+        self.kind = kind
+        self.n = n
+        self.p = p
+        self.rng = random.Random(seed)
+        self.calls = 0
+        self.fires = 0
+
+
+def parse_fault_spec(spec: Optional[str]) -> Dict[str, tuple]:
+    """Parse ``site:kind[:arg][;site:kind...]`` -> {site: (kind, n, p, seed)}.
+
+    Unknown sites or kinds raise loudly — a typo'd plant would otherwise
+    "prove" resilience by never firing (the same discipline as
+    obs/fingerprint.parse_inject)."""
+    out: Dict[str, tuple] = {}
+    if not spec:
+        return out
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2 or len(bits) > 3:
+            raise ValueError(
+                f"fault spec must be 'site:kind[:arg]'; got {part!r}"
+            )
+        site = bits[0].strip()
+        kind = bits[1].strip().lower().replace("-", "_")
+        arg = bits[2].strip() if len(bits) == 3 else ""
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"fault spec names unknown site {site!r} "
+                f"(known: {', '.join(sorted(FAULT_SITES))})"
+            )
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault spec names unknown kind {kind!r} "
+                f"(known: {', '.join(FAULT_KINDS)})"
+            )
+        n, p, seed = 1, 0.0, 0
+        if kind == "raise_first_n":
+            if not arg:
+                raise ValueError(f"raise_first_n needs a count; got {part!r}")
+            n = int(arg)
+            if n < 1:
+                raise ValueError(f"raise_first_n count must be >= 1; got {n}")
+        elif kind == "flaky_p":
+            if not arg:
+                raise ValueError(f"flaky_p needs a probability; got {part!r}")
+            p_str, _, seed_str = arg.partition("@")
+            p = float(p_str)
+            if not (0.0 < p <= 1.0):
+                raise ValueError(f"flaky_p probability must be in (0, 1]; got {p}")
+            seed = int(seed_str) if seed_str else 0
+        elif kind == "corrupt_bytes":
+            n = int(arg) if arg else DEFAULT_CORRUPT_BYTES
+            if n < 1:
+                raise ValueError(f"corrupt_bytes count must be >= 1; got {n}")
+        elif arg:
+            raise ValueError(f"kind {kind!r} takes no argument; got {part!r}")
+        if site in out:
+            raise ValueError(f"fault spec plants site {site!r} twice")
+        out[site] = (kind, n, p, seed)
+    return out
+
+
+class FaultInjector:
+    """Process-scoped planted-fault state for one spec.
+
+    Thread-safe (the serving worker and the async checkpoint writer hit
+    sites off the main thread); the per-plant RNG streams make every firing
+    decision deterministic for a fixed spec, so a chaos run is exactly
+    reproducible."""
+
+    def __init__(self, spec: str) -> None:
+        self.spec = str(spec)
+        self._plants = {
+            site: _Plant(site, kind, n, p, seed)
+            for site, (kind, n, p, seed) in parse_fault_spec(spec).items()
+        }
+        if not self._plants:
+            raise ValueError(f"fault spec {spec!r} plants nothing")
+        self._lock = threading.Lock()
+
+    @property
+    def total_fires(self) -> int:
+        return sum(pl.fires for pl in self._plants.values())
+
+    @property
+    def total_calls(self) -> int:
+        return sum(pl.calls for pl in self._plants.values())
+
+    def plant(self, site: str) -> Optional[_Plant]:
+        return self._plants.get(site)
+
+    def fire(self, site: str, metrics: Optional[MetricsRegistry] = None) -> None:
+        """Raise :class:`InjectedFault` when a raise-kind plant at ``site``
+        is due. corrupt_bytes plants never raise here."""
+        pl = self._plants.get(site)
+        if pl is None or pl.kind == "corrupt_bytes":
+            return
+        with self._lock:
+            pl.calls += 1
+            if pl.kind == "raise_once":
+                due = pl.fires < 1
+            elif pl.kind == "raise_first_n":
+                due = pl.fires < pl.n
+            elif pl.kind == "raise_always":
+                due = True
+            else:  # flaky_p
+                due = pl.rng.random() < pl.p
+            if due:
+                pl.fires += 1
+                calls = pl.calls
+        if due:
+            (metrics if metrics is not None else global_metrics()).counter(
+                "fault_injected"
+            ).inc()
+            raise InjectedFault(
+                f"injected fault at site {site!r} ({pl.kind}, hit {calls})",
+                site,
+            )
+
+    def corrupt_file(
+        self, site: str, path: str, metrics: Optional[MetricsRegistry] = None
+    ) -> bool:
+        """corrupt_bytes plant: overwrite bytes of ``path`` in place (first
+        hit only — one silently corrupted chunk is the scenario; corrupting
+        every write would just be a slower spelling of the same recovery).
+        Returns True when the file was corrupted."""
+        pl = self._plants.get(site)
+        if pl is None or pl.kind != "corrupt_bytes":
+            return False
+        with self._lock:
+            pl.calls += 1
+            if pl.fires >= 1:
+                return False
+            pl.fires += 1
+            garbage = bytes(pl.rng.randrange(256) for _ in range(pl.n))
+        size = os.path.getsize(path)
+        if size == 0:
+            return False
+        with open(path, "r+b") as f:
+            f.seek(min(size // 3, size - 1))
+            f.write(garbage)
+        (metrics if metrics is not None else global_metrics()).counter(
+            "fault_injected"
+        ).inc()
+        return True
+
+
+# -- process-global resolution ------------------------------------------------
+
+_LOCK = threading.Lock()
+_EXPLICIT: Optional[FaultInjector] = None
+_ENV_CACHE: tuple = (None, None)  # (spec string, FaultInjector)
+
+
+def install_fault(spec: str) -> FaultInjector:
+    """Install an explicit injector (beats the env var) and return it —
+    callers (tools/chaos_audit.py) inspect its ``total_fires`` afterwards to
+    prove the planted fault actually fired."""
+    global _EXPLICIT
+    inj = FaultInjector(spec)
+    with _LOCK:
+        _EXPLICIT = inj
+    return inj
+
+
+def clear_fault() -> None:
+    """Remove the explicit injector and drop the env-spec cache (a re-read
+    of an unchanged env spec then starts from fresh plant state)."""
+    global _EXPLICIT, _ENV_CACHE
+    with _LOCK:
+        _EXPLICIT = None
+        _ENV_CACHE = (None, None)
+
+
+@contextlib.contextmanager
+def fault_scope(spec: Optional[str]) -> Iterator[Optional[FaultInjector]]:
+    """Install ``spec`` for the duration of a block (``ClusterConfig.
+    fault_inject`` rides this through api.consensus_clust); None is inert —
+    env-planted faults still apply. The previous explicit injector is
+    restored on exit."""
+    if not spec:
+        yield None
+        return
+    global _EXPLICIT
+    inj = FaultInjector(spec)
+    with _LOCK:
+        prev, _EXPLICIT = _EXPLICIT, inj
+    try:
+        yield inj
+    finally:
+        with _LOCK:
+            _EXPLICIT = prev
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The installed injector, else one resolved from ``CCTPU_FAULT_INJECT``
+    (cached while the spec string is unchanged, so plant state — raise_once
+    already fired — survives across calls). None when nothing is planted:
+    the fast path is one dict lookup."""
+    global _ENV_CACHE
+    if _EXPLICIT is not None:
+        return _EXPLICIT
+    spec = os.environ.get("CCTPU_FAULT_INJECT") or None
+    if spec is None:
+        return None
+    with _LOCK:
+        if _ENV_CACHE[0] != spec:
+            _ENV_CACHE = (spec, FaultInjector(spec))
+        return _ENV_CACHE[1]
+
+
+def maybe_fail(site: str, metrics: Optional[MetricsRegistry] = None) -> None:
+    """Raise the planted fault for ``site`` when one is installed and due.
+    The off path (no injector) is one env-dict lookup — zero device work,
+    zero allocation."""
+    inj = active_injector()
+    if inj is not None:
+        inj.fire(site, metrics)
+
+
+def maybe_corrupt_file(
+    site: str, path: str, metrics: Optional[MetricsRegistry] = None
+) -> bool:
+    """Apply a planted corrupt_bytes fault to ``path`` (write sites call
+    this after their atomic rename lands). No-op / False when off."""
+    inj = active_injector()
+    if inj is None:
+        return False
+    return inj.corrupt_file(site, path, metrics)

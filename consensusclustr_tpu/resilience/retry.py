@@ -1,0 +1,154 @@
+"""Bounded retry with deterministic backoff (ISSUE 10 tentpole).
+
+One policy object, one entry point: :func:`retry_call` wraps the package's
+fault sites — bootstrap/null chunk dispatch (``ChunkPipeline.dispatch``),
+checkpoint read/write (consensus/pipeline.py around utils/checkpoint.py),
+and serving warm-up / micro-batch execution (serve/service.py). Contract:
+
+  * bounded attempts (``attempts`` total, so ``attempts - 1`` retries);
+  * exponential backoff ``base_s * 2**(attempt-1)`` capped at
+    ``max_backoff_s``, with *deterministic seeded jitter* — the jitter
+    fraction for (seed, site, attempt) is a pure function, so two runs of
+    the same workload sleep identically and a chaos audit is reproducible
+    to the wall clock;
+  * an optional overall ``deadline_s`` — a site that keeps failing slowly
+    stops retrying when the budget is spent even if attempts remain;
+  * a call that exhausts retries surfaces the ORIGINAL (last) exception —
+    never a wrapper — preserving the drain semantics every call site
+    already has;
+  * observability: ``retry_attempts`` / ``retries_exhausted`` counters, the
+    ``retry_backoff_seconds`` histogram, and ``retry`` /
+    ``retries_exhausted`` span events naming the site (obs/schema.py).
+
+Injection integration: each attempt runs ``inject.maybe_fail(site)`` before
+the wrapped work, so raise-kind plants fire exactly once per attempt and a
+transient plant (raise_once) is consumed by attempt 1 with attempt 2
+recovering. With nothing planted that check is one dict lookup — the
+zero-overhead-when-off contract is pinned alongside numerics'.
+
+Only ``Exception`` is retried: ``KeyboardInterrupt`` / ``SystemExit`` (and
+any other ``BaseException``) propagate immediately — a retry loop must never
+swallow an operator's ^C.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Optional
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+from consensusclustr_tpu.obs.tracer import metrics_of, tracer_of
+from consensusclustr_tpu.resilience.inject import maybe_fail
+
+DEFAULT_RETRY_ATTEMPTS = 3
+DEFAULT_RETRY_BASE_S = 0.02
+DEFAULT_RETRY_MAX_BACKOFF_S = 2.0
+DEFAULT_RETRY_JITTER = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry knobs; build through :func:`resolve_retry_policy`."""
+
+    attempts: int = DEFAULT_RETRY_ATTEMPTS
+    base_s: float = DEFAULT_RETRY_BASE_S
+    max_backoff_s: float = DEFAULT_RETRY_MAX_BACKOFF_S
+    deadline_s: Optional[float] = None
+    jitter: float = DEFAULT_RETRY_JITTER
+    seed: int = 0
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        """Sleep before retry #``attempt`` (1-based): capped exponential with
+        deterministic jitter — a pure function of (seed, site, attempt), so
+        identical runs back off identically (no thundering-herd sync either:
+        different sites jitter differently)."""
+        raw = min(self.base_s * (2.0 ** (attempt - 1)), self.max_backoff_s)
+        u = random.Random(f"{self.seed}:{site}:{attempt}").random()
+        return raw * (1.0 + self.jitter * u)
+
+
+def resolve_retry_policy(
+    attempts: Optional[int] = None,
+    base_s: Optional[float] = None,
+    deadline_s: Optional[float] = None,
+    seed: int = 0,
+) -> RetryPolicy:
+    """Explicit args > ``CCTPU_RETRY_ATTEMPTS`` / ``CCTPU_RETRY_BASE_S`` /
+    ``CCTPU_RETRY_DEADLINE_S`` env > defaults (3 attempts, 20 ms base).
+    ``attempts=1`` is the fail-fast policy — the wrapper degenerates to a
+    plain call (plus the injection check)."""
+    if attempts is None:
+        attempts = int(
+            os.environ.get("CCTPU_RETRY_ATTEMPTS", DEFAULT_RETRY_ATTEMPTS)
+        )
+    attempts = int(attempts)
+    if attempts < 1:
+        raise ValueError(f"retry attempts must be >= 1; got {attempts}")
+    if base_s is None:
+        base_s = float(
+            os.environ.get("CCTPU_RETRY_BASE_S", DEFAULT_RETRY_BASE_S)
+        )
+    if deadline_s is None:
+        env = os.environ.get("CCTPU_RETRY_DEADLINE_S", "").strip()
+        deadline_s = float(env) if env else None
+    return RetryPolicy(
+        attempts=attempts, base_s=float(base_s), deadline_s=deadline_s,
+        seed=seed,
+    )
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    metrics: Optional[MetricsRegistry] = None,
+    log: Any = None,
+) -> Any:
+    """Run ``fn()`` under the retry policy for fault site ``site``.
+
+    Success on any attempt returns ``fn``'s value; exhaustion re-raises the
+    last exception unchanged. Counters/events go to ``metrics`` (or the
+    log's registry) and the log's tracer — both optional, and nothing is
+    touched on the no-failure path beyond the injection check.
+    """
+    pol = policy if policy is not None else resolve_retry_policy()
+    deadline = (
+        time.monotonic() + pol.deadline_s if pol.deadline_s is not None else None
+    )
+    last: Optional[Exception] = None
+    attempt = 0
+    for attempt in range(1, pol.attempts + 1):
+        try:
+            maybe_fail(site, metrics)
+            return fn()
+        except Exception as e:
+            last = e
+            if attempt >= pol.attempts:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            backoff = pol.backoff_s(site, attempt)
+            mets = metrics if metrics is not None else metrics_of(log)
+            mets.counter("retry_attempts").inc()
+            mets.histogram("retry_backoff_seconds").observe(backoff)
+            tr = tracer_of(log)
+            if tr is not None:
+                tr.event(
+                    "retry", site=site, attempt=attempt,
+                    error=type(e).__name__, backoff_s=round(backoff, 4),
+                )
+            time.sleep(backoff)
+    mets = metrics if metrics is not None else metrics_of(log)
+    mets.counter("retries_exhausted").inc()
+    tr = tracer_of(log)
+    if tr is not None:
+        tr.event(
+            "retries_exhausted", site=site, attempts=attempt,
+            error=type(last).__name__,
+        )
+    assert last is not None
+    raise last

@@ -68,6 +68,20 @@ scrape mid-shutdown sees live ``host_rss_bytes`` / ``host_peak_rss_bytes``
 gauges on ``/metrics``), and stops last in ``close()`` so the final sample
 is the service's closing watermark.
 
+Resilience (ISSUE 10): warm-up and micro-batch device execution are fault
+sites (``serve_warmup`` / ``serve_batch``, obs/schema.py::FAULT_SITES)
+wrapped in the bounded-backoff retry policy — a transient dispatch failure
+re-runs the pure batch function bit-identically; exhaustion falls through to
+*poisoned-batch isolation* (only that batch's futures fail, everything else
+keeps serving). The worker thread itself is supervised: an unexpected death
+(``serve_worker`` site) increments ``serve_worker_restarts``, emits a
+``serve_worker_restart`` event, and restarts the loop over the SAME pending
+deque so no accepted request is stranded; past the restart limit
+(``CCTPU_SERVE_WORKER_RESTARTS``, default 16) the service fails everything
+pending loudly rather than crash-loop. Rejections carry a ``retry_after_s``
+hint derived from the observed batch drain rate (see
+:class:`RetryableRejection`).
+
 Knob resolution follows the package's env-override pattern
 (parallel/pipelined.pipeline_depth): explicit argument >
 ``ClusterConfig.serve_*`` field > ``CCTPU_SERVE_*`` env var > default.
@@ -108,11 +122,32 @@ DEFAULT_QUEUE_DEPTH = 64
 # the lifecycle histograms and counters keep going forever (docs/quirks.md).
 LIFECYCLE_RECORD_CAP = 100_000
 
+# Supervision (ISSUE 10): how many unexpected worker deaths the supervisor
+# absorbs before declaring the service dead (failing everything pending and
+# refusing new submits). A restart preserves the pending deque — no accepted
+# request is lost to a worker crash. CCTPU_SERVE_WORKER_RESTARTS overrides.
+DEFAULT_WORKER_RESTART_LIMIT = 16
+
+# Completed-batch window the retry_after_s hint derives from: enough batches
+# to smooth one noisy dispatch, small enough to track a regime change.
+_DRAIN_WINDOW = 32
+
 _SENTINEL = None
 
 
 class RetryableRejection(RuntimeError):
-    """Queue-full backpressure: nothing was enqueued; back off and retry."""
+    """Queue-full backpressure: nothing was enqueued; back off and retry.
+
+    ``retry_after_s`` (ISSUE 10) is the service's own backoff hint — the
+    current queue depth divided by the drain rate observed over the last few
+    completed batches, i.e. roughly when a queue slot should free up. None
+    until the service has completed enough batches to know its rate. Purely
+    advisory: tools/loadgen.py records it but never acts on it (the
+    generator stays open-loop by design)."""
+
+    def __init__(self, message: str = "", retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 def serve_queue_depth(requested: Optional[int] = None) -> int:
@@ -124,6 +159,20 @@ def serve_queue_depth(requested: Optional[int] = None) -> int:
     v = int(requested)
     if v < 1:
         raise ValueError(f"serve_queue_depth must be >= 1; got {v}")
+    return v
+
+
+def worker_restart_limit(requested: Optional[int] = None) -> int:
+    """Explicit arg > $CCTPU_SERVE_WORKER_RESTARTS > 16."""
+    if requested is None:
+        requested = int(
+            os.environ.get(
+                "CCTPU_SERVE_WORKER_RESTARTS", DEFAULT_WORKER_RESTART_LIMIT
+            )
+        )
+    v = int(requested)
+    if v < 0:
+        raise ValueError(f"worker restart limit must be >= 0; got {v}")
     return v
 
 
@@ -255,6 +304,7 @@ class AssignmentService:
         tracer: Optional[Tracer] = None,
         metrics_port: Optional[int] = None,
         resource_sample_ms: Optional[int] = None,
+        retry_attempts: Optional[int] = None,
     ) -> None:
         if mode not in ("robust", "granular"):
             raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
@@ -286,6 +336,26 @@ class AssignmentService:
         self._thread: Optional[threading.Thread] = None
         self._closing = False
         self._closed = False
+        # Resilience (ISSUE 10): bounded retries around warm-up and
+        # micro-batch device execution, and a supervised worker — requests
+        # pulled off the queue live in self._pending so a worker restart
+        # resumes them instead of stranding their futures.
+        from collections import deque as _deque
+
+        from consensusclustr_tpu.resilience.retry import resolve_retry_policy
+
+        self._retry = resolve_retry_policy(
+            retry_attempts
+            if retry_attempts is not None
+            else getattr(cfg, "retry_attempts", None)
+        )
+        self._pending: "_deque[_Request]" = _deque()
+        self._drained = False
+        self._worker_restarts = 0
+        self._restart_limit = worker_restart_limit()
+        self._drain_window: "_deque[Tuple[float, int]]" = _deque(
+            maxlen=_DRAIN_WINDOW
+        )
         self._metrics_port_req = serve_metrics_port(
             metrics_port
             if metrics_port is not None
@@ -328,20 +398,30 @@ class AssignmentService:
             enable_persistent_cache,
         )
 
+        from consensusclustr_tpu.resilience.inject import SERVE_WARMUP_SITE
+        from consensusclustr_tpu.resilience.retry import retry_call
+
         enable_persistent_cache()
         g = self.reference.n_hvg
         with self.tracer.span(
             "serve_warmup", buckets=list(self.buckets), n_hvg=g
         ) as sp:
             for b in self.buckets:
-                codes, _, _, _ = assign_bucketed(
-                    self.reference,
-                    np.zeros((b, g), np.float32),
-                    k=self.k,
-                    buckets=(b,),
-                    snap_eps=self.snap_eps,
-                    metrics=self.metrics,
-                    compile_tracker=self._tracker,
+                # per-bucket warm-up dispatch under the retry policy: a
+                # transient compile/dispatch failure must not abort the
+                # whole service load
+                codes, _, _, _ = retry_call(
+                    lambda b=b: assign_bucketed(
+                        self.reference,
+                        np.zeros((b, g), np.float32),
+                        k=self.k,
+                        buckets=(b,),
+                        snap_eps=self.snap_eps,
+                        metrics=self.metrics,
+                        compile_tracker=self._tracker,
+                    ),
+                    site=SERVE_WARMUP_SITE, policy=self._retry,
+                    metrics=self.metrics, log=self.tracer,
                 )
                 assert codes.shape == (b,)
             sp.set(compiles=self._tracker.count)
@@ -351,7 +431,7 @@ class AssignmentService:
             raise RuntimeError("AssignmentService already closed")
         if self._thread is None:
             self._thread = threading.Thread(
-                target=self._loop, name="cctpu-assign-service", daemon=True
+                target=self._worker, name="cctpu-assign-service", daemon=True
             )
             self._thread.start()
             self.tracer.event(
@@ -428,8 +508,11 @@ class AssignmentService:
             self._queue.put_nowait(req)
         except queue.Full:
             self.metrics.counter("serve_rejections").inc()
+            hint = self.retry_after_hint()
             raise RetryableRejection(
                 f"queue full ({self.queue_depth} requests in flight); retry"
+                + (f" after ~{hint}s" if hint is not None else ""),
+                retry_after_s=hint,
             ) from None
         self._accepted += 1
         self.metrics.gauge("queue_depth").set(self._queue.qsize())
@@ -445,14 +528,70 @@ class AssignmentService:
 
     # -- worker side ---------------------------------------------------------
 
-    def _loop(self) -> None:
-        from collections import deque
-
-        pending: "deque[_Request]" = deque()
-        drained = False
+    def _worker(self) -> None:
+        """Supervised worker (ISSUE 10): ``_loop`` does the serving; an
+        unexpected death (anything escaping the per-batch isolation — a bug
+        in the loop scaffolding, an injected ``serve_worker`` fault) is
+        counted, evented, and the loop restarts over the SAME pending deque,
+        so no accepted request's future is lost to a crash. Past the restart
+        limit the supervisor gives up loudly: everything pending or queued
+        fails, intake closes."""
         while True:
+            try:
+                self._loop()
+                return  # clean exit: drained after close()
+            except BaseException as e:
+                if self._closed:
+                    return
+                self._worker_restarts += 1
+                self.metrics.counter("serve_worker_restarts").inc()
+                self.tracer.event(
+                    "serve_worker_restart",
+                    error=type(e).__name__,
+                    restarts=self._worker_restarts,
+                )
+                if self._worker_restarts > self._restart_limit:
+                    self._fail_all(
+                        RuntimeError(
+                            f"serve worker exceeded restart limit "
+                            f"({self._restart_limit}); last error: "
+                            f"{type(e).__name__}: {e}"
+                        )
+                    )
+                    return
+
+    def _fail_all(self, err: BaseException) -> None:
+        """Give-up path: close intake and fail every pending/queued future
+        rather than strand callers on a dead worker."""
+        self._closing = True
+        while self._pending:
+            req = self._pending.popleft()
+            if not req.future.done():
+                req.future.set_exception(err)
+                self._completed += 1
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _SENTINEL and not req.future.done():
+                req.future.set_exception(err)
+                self._completed += 1
+
+    def _loop(self) -> None:
+        from consensusclustr_tpu.resilience.inject import (
+            SERVE_WORKER_SITE,
+            maybe_fail,
+        )
+
+        pending = self._pending  # survives worker restarts (supervision)
+        while True:
+            # fault site: the worker loop itself — a planted fault here
+            # lands OUTSIDE the per-batch isolation, so it exercises the
+            # supervisor's restart path (no request may be lost)
+            maybe_fail(SERVE_WORKER_SITE, self.metrics)
             if not pending:
-                if drained:
+                if self._drained:
                     return
                 item = self._queue.get()
                 if item is _SENTINEL:
@@ -460,13 +599,13 @@ class AssignmentService:
                 item.t_dequeue = time.perf_counter()  # queue_wait ends here
                 pending.append(item)
             # opportunistic non-blocking drain: batch whatever has piled up
-            while not drained:
+            while not self._drained:
                 try:
                     item = self._queue.get_nowait()
                 except queue.Empty:
                     break
                 if item is _SENTINEL:
-                    drained = True
+                    self._drained = True
                     break
                 item.t_dequeue = time.perf_counter()
                 pending.append(item)
@@ -512,10 +651,24 @@ class AssignmentService:
                     queue_age_max_s=round(max(ages), 6),
                     queue_age_mean_s=round(sum(ages) / len(ages), 6),
                 )
-                codes, frac, stab, dist = assign_bucketed(
-                    self.reference, counts, k=self.k, buckets=self.buckets,
-                    snap_eps=self.snap_eps, metrics=self.metrics,
-                    compile_tracker=self._tracker,
+                # micro-batch device execution under the retry policy
+                # (ISSUE 10): a transient failure re-dispatches (pure
+                # function of the batch — bit-identical on the retried
+                # attempt); exhaustion falls through to the poisoned-batch
+                # isolation below, failing only THIS batch's futures.
+                from consensusclustr_tpu.resilience.inject import (
+                    SERVE_BATCH_SITE,
+                )
+                from consensusclustr_tpu.resilience.retry import retry_call
+
+                codes, frac, stab, dist = retry_call(
+                    lambda: assign_bucketed(
+                        self.reference, counts, k=self.k, buckets=self.buckets,
+                        snap_eps=self.snap_eps, metrics=self.metrics,
+                        compile_tracker=self._tracker,
+                    ),
+                    site=SERVE_BATCH_SITE, policy=self._retry,
+                    metrics=self.metrics, log=self.tracer,
                 )
                 t_done = time.perf_counter()
                 device_s = t_done - t_dispatch
@@ -568,8 +721,34 @@ class AssignmentService:
                     if not req.future.done():
                         req.future.set_exception(e)
                         self._completed += 1
+            finally:
+                # drain-rate observation (retry_after_s hint): a batch —
+                # served or failed — freed its queue slots at this instant
+                self._drain_window.append((time.perf_counter(), len(batch)))
 
     # -- introspection -------------------------------------------------------
+
+    def retry_after_hint(self) -> Optional[float]:
+        """Advisory backoff for a rejected submit: current queue occupancy
+        over the request drain rate observed across the last completed
+        batches — roughly when a slot should free. None until at least two
+        batches have completed (no rate to observe). Lock-free: the window
+        is appended by the worker only; a racy read costs at most one stale
+        batch."""
+        window = list(self._drain_window)
+        if len(window) < 2:
+            return None
+        span = window[-1][0] - window[0][0]
+        served = sum(n for _, n in window[1:])
+        if span <= 0.0 or served <= 0:
+            return None
+        rate = served / span
+        waiting = self._queue.qsize() + 1  # +1: the rejected request itself
+        return round(min(max(waiting / rate, 0.001), 30.0), 4)
+
+    @property
+    def worker_restarts(self) -> int:
+        return self._worker_restarts
 
     @property
     def bucket_compiles(self) -> int:
@@ -593,6 +772,7 @@ class AssignmentService:
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
             "bucket_compiles": self.bucket_compiles,
+            "worker_restarts": self._worker_restarts,
         }
 
     def run_record(self, config=None) -> RunRecord:

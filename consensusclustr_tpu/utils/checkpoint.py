@@ -8,8 +8,19 @@ The co-clustering distance and everything after it is cheap relative to the
 boots and is recomputed.
 
 Layout (one directory per run):
-    meta.json             fingerprint + shapes
-    boots_<start>.npz     labels [chunk, n] int32, scores [chunk]
+    meta.json                    fingerprint + shapes
+    boots_<start>.npz            labels [chunk, n] int32, scores [chunk]
+    boots_<start>.npz.sha256     integrity sidecar (hex digest of the npz)
+
+Integrity contract (ISSUE 10): writes are atomic (tmp file + ``os.replace``,
+so a kill mid-write can never leave a torn final file), each chunk's sha256
+lands in a sidecar written after the data file, and resume treats a
+checksum-mismatched or unreadable chunk as *missing*: the bad file is
+quarantine-renamed (``*.npz.quarantine``, kept for forensics), the
+``ckpt_quarantined`` counter and event fire, and the chunk is recomputed —
+never crashed on, never silently resumed. A chunk whose sidecar is absent
+(legacy checkpoint, or a crash between data and sidecar rename) is accepted
+on the shape checks alone — the sidecar upgrade must not orphan old runs.
 
 Orbax is the right tool for sharded device arrays; boot labels are small
 host-side int32 matrices, so plain npz keeps the dependency surface at numpy.
@@ -26,6 +37,18 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 _CHUNK_RE = re.compile(r"^boots_(\d+)\.npz$")
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class ChunkIntegrityError(RuntimeError):
+    """A chunk file's bytes do not match its recorded sha256 sidecar."""
 
 
 def run_fingerprint(pca: np.ndarray, cfg_fields: Dict, key_bytes: bytes) -> str:
@@ -48,6 +71,10 @@ class BootCheckpoint:
     Chunks live in a per-fingerprint subdirectory of `directory`, so multiple
     runs (e.g. every subproblem of an iterate=True recursion) share one
     checkpoint root without ever invalidating each other's chunks.
+
+    ``metrics``/``log`` (optional) receive the quarantine telemetry — the
+    ``ckpt_quarantined`` counter and event; absent, the counter goes to the
+    process-global registry and the event is dropped.
     """
 
     def __init__(
@@ -57,6 +84,8 @@ class BootCheckpoint:
         nboots: int,
         n_cells: int,
         rows_per_boot: int = 1,
+        metrics=None,
+        log=None,
     ):
         """rows_per_boot > 1 is granular mode: each boot contributes its full
         |k_num| * |res_range| candidate slab, stored flattened boot-major as
@@ -68,10 +97,12 @@ class BootCheckpoint:
         self.nboots = nboots
         self.n_cells = n_cells
         self.rows_per_boot = rows_per_boot
+        self.metrics = metrics
+        self.log = log
         os.makedirs(self.dir, exist_ok=True)
-        # clean torn writes from a previous crash
+        # clean torn writes from a previous crash (data tmps AND sidecar tmps)
         for name in os.listdir(self.dir):
-            if name.endswith(".tmp.npz"):
+            if name.endswith(".tmp.npz") or name.endswith(".tmp"):
                 try:
                     os.unlink(os.path.join(self.dir, name))
                 except OSError:
@@ -88,16 +119,77 @@ class BootCheckpoint:
     def _chunk_path(self, start: int) -> str:
         return os.path.join(self.dir, f"boots_{start:06d}.npz")
 
+    @staticmethod
+    def _sidecar_path(path: str) -> str:
+        return path + ".sha256"
+
+    def _metrics(self):
+        if self.metrics is not None:
+            return self.metrics
+        from consensusclustr_tpu.obs.metrics import global_metrics
+
+        return global_metrics()
+
+    def _quarantine(self, start: int, path: str, reason: str) -> None:
+        """Rename a corrupt/unreadable chunk (and its sidecar) aside so the
+        resume recomputes it; the renamed file is kept for forensics. The
+        quarantine itself must never fail the run — worst case the bad file
+        stays and keeps being treated as missing."""
+        qpath = path + ".quarantine"
+        try:
+            os.replace(path, qpath)
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        sidecar = self._sidecar_path(path)
+        if os.path.exists(sidecar):
+            try:
+                os.replace(sidecar, sidecar + ".quarantine")
+            except OSError:
+                pass
+        self._metrics().counter("ckpt_quarantined").inc()
+        from consensusclustr_tpu.utils.log import get_logger
+
+        get_logger().warning(
+            "checkpoint chunk %s quarantined (%s); it will be recomputed",
+            os.path.basename(path), reason,
+        )
+        if self.log is not None:
+            try:
+                self.log.event(
+                    "ckpt_quarantined", chunk_start=int(start), reason=reason,
+                    path=os.path.basename(path),
+                )
+            except Exception:
+                pass
+
     def load_chunk(self, start: int, size: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         path = self._chunk_path(start)
         if not os.path.exists(path):
             return None
         try:
+            sidecar = self._sidecar_path(path)
+            if os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    want = f.read().strip()
+                if want and _sha256_file(path) != want:
+                    raise ChunkIntegrityError(
+                        f"sha256 mismatch for {os.path.basename(path)}"
+                    )
             with np.load(path) as z:
                 labels, scores = z["labels"], z["scores"]
-        except Exception:
-            return None  # torn write: recompute this chunk
+        except Exception as e:
+            # torn write / bit rot / checksum mismatch: quarantine-rename and
+            # recompute — a bad chunk must never crash or poison a resume
+            self._quarantine(start, path, type(e).__name__)
+            return None
         if labels.shape != (size * self.rows_per_boot, self.n_cells):
+            # a SHAPE mismatch is not corruption: a resume under a different
+            # chunking legitimately leaves overlapping stale files behind
+            # (chunk size left the fingerprint, ADVICE r4) — skip, don't
+            # quarantine
             return None
         # scores must be per-row too: a malformed-but-loadable scores array
         # would otherwise crash the granular resume reshape downstream
@@ -107,10 +199,29 @@ class BootCheckpoint:
         return labels, scores
 
     def save_chunk(self, start: int, labels: np.ndarray, scores: np.ndarray) -> None:
+        from consensusclustr_tpu.resilience.inject import (
+            CKPT_WRITE_SITE,
+            maybe_corrupt_file,
+        )
+
         path = self._chunk_path(start)
         tmp = path + ".tmp.npz"  # .npz suffix stops savez renaming it
         np.savez(tmp, labels=np.asarray(labels, np.int32), scores=np.asarray(scores))
-        os.replace(tmp, path)
+        digest = _sha256_file(tmp)
+        os.replace(tmp, path)  # atomic: a kill here leaves old-or-new, never torn
+        # sidecar lands after the data file (atomically too): a crash between
+        # the two leaves data without sidecar = accepted legacy chunk, or a
+        # stale sidecar against new data = checksum mismatch -> quarantine +
+        # recompute. Either way the resume stays correct.
+        sidecar = self._sidecar_path(path)
+        sidecar_tmp = sidecar + ".tmp"
+        with open(sidecar_tmp, "w") as f:
+            f.write(digest + "\n")
+        os.replace(sidecar_tmp, sidecar)
+        # fault injection (resilience/inject.py, off by default): a planted
+        # corrupt_bytes fault flips bytes of the FINAL file — simulating the
+        # silent on-disk corruption the sidecar exists to catch at resume
+        maybe_corrupt_file(CKPT_WRITE_SITE, path, self.metrics)
 
     def completed_boots(self) -> int:
         # Count DISTINCT covered boot indices, not file row totals: since

@@ -201,8 +201,13 @@ class TestPipelinedBoots:
         # the background writer landed every chunk atomically: no torn tmps,
         # all three chunk files present
         assert not any(f.endswith(".tmp.npz") for f in files)
-        assert [f for f in files if f.startswith("boots_")] == [
+        assert [f for f in files if f.endswith(".npz")] == [
             "boots_000000.npz", "boots_000002.npz", "boots_000004.npz",
+        ]
+        # every chunk carries its sha256 integrity sidecar (ISSUE 10)
+        assert [f for f in files if f.endswith(".sha256")] == [
+            "boots_000000.npz.sha256", "boots_000002.npz.sha256",
+            "boots_000004.npz.sha256",
         ]
         # kill a middle chunk: the rerun resumes around the hole and the
         # cached/computed interleave is still bit-identical and in order

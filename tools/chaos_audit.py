@@ -1,0 +1,460 @@
+#!/usr/bin/env python
+"""Chaos audit: prove bit-identical results under injected failure.
+
+The resilience layer (resilience/, ISSUE 10) claims that a transient fault at
+any registered site — bootstrap chunk dispatch, checkpoint write/read,
+null-sim dispatch, serving warm-up/batch/worker — is absorbed by the bounded
+retry policy (or checkpoint quarantine) WITHOUT changing a single output bit.
+This tool is the runtime proof, the failures-axis sibling of
+``tools/parity_audit.py``: one seeded workload runs clean, then once per
+fault preset with a deterministic fault planted
+(``resilience/inject.py::install_fault``), and the faulted run must (a)
+complete, (b) actually have fired the planted fault (an audit whose fault
+never fired proves nothing), and (c) produce a final ``labels`` fingerprint
+(obs/fingerprint.py) exactly equal to the clean run's.
+
+Usage:
+    python tools/chaos_audit.py                      # all presets
+    python tools/chaos_audit.py --preset boot_chunk --preset ckpt_torn
+    python tools/chaos_audit.py --json chaos.json    # machine summary
+
+Presets (fault site x a transient kind, plus the failure-semantics checks):
+
+  boot_chunk    boot_chunk:raise_once on the consensus workload — the first
+                chunk dispatch fails once, the retry recovers.
+  ckpt_write    ckpt_write:raise_first_n:2 with a checkpoint dir — the first
+                chunk save fails twice (attempt 3 lands); a follow-up CLEAN
+                resume must also match, proving the retried writes persisted
+                good data.
+  ckpt_corrupt  ckpt_write:corrupt_bytes:64 — a chunk file is silently
+                corrupted on disk after its atomic write + sha256 sidecar;
+                the faulted run itself is unaffected, and the follow-up
+                resume must quarantine the corrupt chunk (ckpt_quarantined
+                >= 1), recompute it, and still match.
+  ckpt_read     ckpt_read:raise_once on a populated checkpoint — the first
+                resume read fails once, the retry recovers the cached chunk.
+  ckpt_torn     no injector: the kill-mid-write simulation. A populated
+                checkpoint gets one chunk truncated and another's bytes
+                flipped by hand; the clean resume must quarantine BOTH
+                (>= 2), recompute, and match.
+  null_chunk    null_chunk:raise_once on the null-statistics workload.
+  serve_warmup / serve_batch
+                raise_once during service warm-up / micro-batch execution;
+                the retried dispatch must reproduce the clean assignments.
+  serve_worker  serve_worker:raise_once — the worker loop dies outside the
+                per-batch isolation; the supervisor restart must lose no
+                request and reproduce the clean assignments
+                (serve_worker_restarts >= 1).
+  permanent     boot_chunk:raise_always — the NEGATIVE control: retries must
+                exhaust (fires == policy attempts) and the original
+                InjectedFault must surface, not be swallowed.
+
+Exit codes: 0 all presets recovered bit-identically; 1 usage; 3 divergence,
+non-recovery, or a planted fault that never fired.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# preset name -> (fault spec or None, workload driver name)
+PRESETS: Dict[str, Tuple[Optional[str], str]] = {
+    "boot_chunk": ("boot_chunk:raise_once", "consensus"),
+    "ckpt_write": ("ckpt_write:raise_first_n:2", "checkpoint"),
+    "ckpt_corrupt": ("ckpt_write:corrupt_bytes:64", "corrupt"),
+    "ckpt_read": ("ckpt_read:raise_once", "resume"),
+    "ckpt_torn": (None, "torn"),
+    "null_chunk": ("null_chunk:raise_once", "null"),
+    "serve_warmup": ("serve_warmup:raise_once", "serve"),
+    "serve_batch": ("serve_batch:raise_once", "serve"),
+    "serve_worker": ("serve_worker:raise_once", "serve"),
+    "permanent": ("boot_chunk:raise_always", "permanent"),
+}
+
+
+def smoke_counts(cells: int, genes: int, seed: int):
+    """The seeded NB-mixture CPU-smoke workload (same generator as
+    tools/parity_audit.py — both audits stress the same math)."""
+    from consensusclustr_tpu.utils.synth import nb_mixture_counts
+
+    counts, _ = nb_mixture_counts(
+        n_cells=cells, n_genes=genes, n_populations=3, seed=seed
+    )
+    return counts
+
+
+def labels_fp(labels) -> str:
+    """Order-independent 64-bit fingerprint of a label vector; string labels
+    go through their sorted-unique integer codes (bench.py's convention)."""
+    import numpy as np
+
+    from consensusclustr_tpu.obs.fingerprint import array_fingerprint
+
+    labels = np.asarray(labels)
+    if labels.dtype.kind not in "biufc":
+        labels = np.unique(labels, return_inverse=True)[1]
+    return array_fingerprint(labels.astype(np.int32))["checksum"]
+
+
+class ChaosHarness:
+    """One seeded workload family + its lazily computed clean fingerprints.
+
+    Every faulted run is compared against the SAME clean result; checkpoint
+    runs each get a private directory so presets can never contaminate each
+    other's resume state."""
+
+    def __init__(self, args) -> None:
+        self.args = args
+        self.root = tempfile.mkdtemp(prefix="chaos_audit_")
+        self.counts = smoke_counts(args.cells, args.genes, args.seed)
+        self._clean_consensus: Optional[str] = None
+        self._clean_serve: Optional[str] = None
+        self._clean_null: Optional[str] = None
+        self._artifact = None
+
+    def close(self) -> None:
+        shutil.rmtree(self.root, ignore_errors=True)
+
+    def _cfg(self, ckpt_dir: Optional[str] = None):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        return ClusterConfig(
+            nboots=self.args.boots,
+            pc_num=self.args.pcs,
+            k_num=(5,),
+            res_range=(0.1, 0.5, 1.0),
+            # two boots per chunk -> multiple chunk files, so the torn /
+            # corrupt presets have distinct files to break
+            boot_batch=2,
+            test_significance=False,
+            iterate=False,
+            seed=self.args.seed,
+            checkpoint_dir=ckpt_dir,
+        )
+
+    def consensus_run(self, ckpt_dir: Optional[str] = None):
+        """One consensus_clust run; returns (labels_fp, run_record)."""
+        from consensusclustr_tpu.api import consensus_clust
+
+        res = consensus_clust(self.counts, config=self._cfg(ckpt_dir))
+        return labels_fp(res.assignments), res
+
+    def clean_consensus(self) -> str:
+        if self._clean_consensus is None:
+            self._clean_consensus, self._clean_result = self.consensus_run()
+        return self._clean_consensus
+
+    def chunk_files(self, ckpt_dir: str) -> List[str]:
+        import glob
+
+        return sorted(glob.glob(os.path.join(ckpt_dir, "*", "boots_*.npz")))
+
+    def quarantined(self, res) -> int:
+        rec = getattr(res, "run_record", None)
+        counters = (rec.metrics or {}).get("counters", {}) if rec else {}
+        return int(counters.get("ckpt_quarantined", 0))
+
+    # -- serving -------------------------------------------------------------
+
+    def artifact(self):
+        if self._artifact is None:
+            from consensusclustr_tpu.api import export_reference
+
+            self.clean_consensus()  # ensures self._clean_result
+            self._artifact = export_reference(
+                self._clean_result, os.path.join(self.root, "reference")
+            )
+        return self._artifact
+
+    def serve_run(self) -> Tuple[str, int]:
+        """Serve a fixed request mix; returns (labels_fp, worker_restarts).
+        The worker-death preset needs requests IN FLIGHT when the fault
+        fires, so the service starts after the submits."""
+        import numpy as np
+
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art = self.artifact()
+        queries = [self.counts[:1], self.counts[1:4], self.counts[4:9]]
+        with AssignmentService(
+            art, queue_depth=8, max_batch=16, buckets=(16,), start=False
+        ) as svc:
+            futures = [svc.submit(q) for q in queries]
+            svc.start()
+            got = [f.result(timeout=120).labels for f in futures]
+            restarts = svc.worker_restarts
+        return labels_fp(np.concatenate(got)), restarts
+
+    def clean_serve(self) -> str:
+        if self._clean_serve is None:
+            self._clean_serve, _ = self.serve_run()
+        return self._clean_serve
+
+    # -- null statistics -----------------------------------------------------
+
+    def null_run(self) -> str:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from consensusclustr_tpu.nulltest import generate_null_statistics
+        from consensusclustr_tpu.nulltest.copula import CopulaModel
+        from consensusclustr_tpu.obs.fingerprint import array_fingerprint
+
+        g = 6
+        model = CopulaModel(
+            mu=jnp.full((g,), 5.0, jnp.float32),
+            theta=jnp.full((g,), 2.0, jnp.float32),
+            chol=jnp.eye(g, dtype=jnp.float32),
+        )
+        stats = generate_null_statistics(
+            jax.random.key(self.args.seed), model, n_cells=40, pc_num=3,
+            n_sims=4, k_num=(5,), max_clusters=16, chunk=2,
+            res_range=(0.3, 0.8),
+        )
+        return array_fingerprint(np.asarray(stats, np.float32))["checksum"]
+
+    def clean_null(self) -> str:
+        if self._clean_null is None:
+            self._clean_null = self.null_run()
+        return self._clean_null
+
+
+def _tear_checkpoint(files: List[str]) -> int:
+    """The kill-mid-write simulation: truncate the first chunk file and flip
+    bytes inside the second (its sha256 sidecar now lies about it). Returns
+    how many files were damaged."""
+    damaged = 0
+    if files:
+        with open(files[0], "r+b") as f:
+            f.truncate(max(os.path.getsize(files[0]) // 4, 1))
+        damaged += 1
+    if len(files) > 1:
+        with open(files[1], "r+b") as f:
+            f.seek(max(os.path.getsize(files[1]) // 3, 0))
+            f.write(b"\x00CHAOS\x00" * 8)
+        damaged += 1
+    return damaged
+
+
+def audit_preset(name: str, harness: ChaosHarness) -> dict:
+    """Run one preset; returns the machine-readable verdict."""
+    from consensusclustr_tpu.resilience.inject import (
+        InjectedFault,
+        clear_fault,
+        install_fault,
+    )
+
+    spec, workload = PRESETS[name]
+    out: dict = {"preset": name, "spec": spec, "workload": workload}
+    inj = None
+    try:
+        if workload == "consensus":
+            want = harness.clean_consensus()
+            inj = install_fault(spec)
+            got, _ = harness.consensus_run()
+            out.update(fingerprint_match=(got == want), recovered=True)
+            out["ok"] = out["fingerprint_match"] and inj.total_fires >= 1
+
+        elif workload == "checkpoint":
+            want = harness.clean_consensus()
+            ckpt = os.path.join(harness.root, name)
+            inj = install_fault(spec)
+            got, _ = harness.consensus_run(ckpt)
+            clear_fault()
+            inj_fires = inj.total_fires
+            # the retried writes must have persisted GOOD data: a clean
+            # resume over them has to match too
+            got2, res2 = harness.consensus_run(ckpt)
+            out.update(
+                fingerprint_match=(got == want and got2 == want),
+                recovered=True, resume_quarantined=harness.quarantined(res2),
+            )
+            out["ok"] = (
+                out["fingerprint_match"]
+                and inj_fires >= 1
+                and out["resume_quarantined"] == 0
+            )
+            out["fires"] = inj_fires
+            return out
+
+        elif workload == "corrupt":
+            want = harness.clean_consensus()
+            ckpt = os.path.join(harness.root, name)
+            inj = install_fault(spec)
+            got1, _ = harness.consensus_run(ckpt)  # corruption lands on disk
+            clear_fault()
+            got2, res2 = harness.consensus_run(ckpt)  # resume must catch it
+            q = harness.quarantined(res2)
+            out.update(
+                fingerprint_match=(got1 == want and got2 == want),
+                recovered=True, resume_quarantined=q,
+            )
+            out["ok"] = (
+                out["fingerprint_match"] and inj.total_fires >= 1 and q >= 1
+            )
+
+        elif workload == "resume":
+            want = harness.clean_consensus()
+            ckpt = os.path.join(harness.root, name)
+            harness.consensus_run(ckpt)  # clean populate
+            inj = install_fault(spec)
+            got, _ = harness.consensus_run(ckpt)  # faulted resume
+            out.update(fingerprint_match=(got == want), recovered=True)
+            out["ok"] = out["fingerprint_match"] and inj.total_fires >= 1
+
+        elif workload == "torn":
+            want = harness.clean_consensus()
+            ckpt = os.path.join(harness.root, name)
+            harness.consensus_run(ckpt)  # clean populate
+            damaged = _tear_checkpoint(harness.chunk_files(ckpt))
+            got, res2 = harness.consensus_run(ckpt)  # clean resume
+            q = harness.quarantined(res2)
+            out.update(
+                fingerprint_match=(got == want), recovered=True,
+                damaged=damaged, resume_quarantined=q,
+            )
+            out["ok"] = out["fingerprint_match"] and q >= damaged >= 1
+
+        elif workload == "null":
+            want = harness.clean_null()
+            inj = install_fault(spec)
+            got = harness.null_run()
+            out.update(fingerprint_match=(got == want), recovered=True)
+            out["ok"] = out["fingerprint_match"] and inj.total_fires >= 1
+
+        elif workload == "serve":
+            want = harness.clean_serve()
+            inj = install_fault(spec)
+            got, restarts = harness.serve_run()
+            out.update(fingerprint_match=(got == want), recovered=True)
+            out["ok"] = out["fingerprint_match"] and inj.total_fires >= 1
+            if name == "serve_worker":
+                out["worker_restarts"] = restarts
+                out["ok"] = out["ok"] and restarts >= 1
+
+        elif workload == "permanent":
+            # the negative control: a permanent fault must NOT recover —
+            # retries exhaust and the ORIGINAL InjectedFault surfaces
+            harness.clean_consensus()
+            from consensusclustr_tpu.resilience.retry import (
+                resolve_retry_policy,
+            )
+
+            attempts = resolve_retry_policy().attempts
+            inj = install_fault(spec)
+            try:
+                harness.consensus_run()
+            except InjectedFault:
+                out.update(
+                    recovered=False, surfaced="InjectedFault",
+                    attempts=attempts,
+                )
+                out["ok"] = inj.total_fires == attempts
+            except Exception as e:  # wrong exception type leaked
+                out.update(recovered=False, surfaced=type(e).__name__)
+                out["ok"] = False
+            else:
+                out.update(recovered=True, surfaced=None)
+                out["ok"] = False  # a permanent fault must not "succeed"
+        else:  # pragma: no cover - registry and drivers move together
+            raise AssertionError(f"unknown workload {workload!r}")
+    except Exception as e:
+        # a faulted run that DIED is the non-recovery this audit exists to
+        # catch (the permanent preset handles its expected failure above)
+        out.update(recovered=False, error=f"{type(e).__name__}: {e}")
+        out["ok"] = False
+    finally:
+        clear_fault()
+    if inj is not None:
+        out.setdefault("fires", inj.total_fires)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--preset", action="append", default=[], metavar="NAME",
+        help=f"fault preset (repeatable; default: all of {', '.join(PRESETS)})",
+    )
+    ap.add_argument("--cells", type=int, default=96,
+                    help="workload cells (default 96 — CPU smoke)")
+    ap.add_argument("--genes", type=int, default=48, help="workload genes")
+    ap.add_argument("--boots", type=int, default=4, help="bootstraps")
+    ap.add_argument("--pcs", type=int, default=3, help="pc_num")
+    ap.add_argument("--seed", type=int, default=7, help="workload + run seed")
+    ap.add_argument("--json", metavar="OUT", default=None,
+                    help="also write the machine summary to this path")
+    args = ap.parse_args(argv)
+
+    presets = args.preset or list(PRESETS)
+    for p in presets:
+        if p not in PRESETS:
+            print(
+                f"chaos_audit: unknown preset {p!r} (known: "
+                f"{', '.join(PRESETS)})",
+                file=sys.stderr,
+            )
+            return 1
+
+    harness = ChaosHarness(args)
+    results = []
+    try:
+        for name in presets:
+            res = audit_preset(name, harness)
+            results.append(res)
+            if res["ok"]:
+                extra = ""
+                if "fires" in res:
+                    extra = f" (fault fired {res['fires']}x)"
+                if res.get("resume_quarantined"):
+                    extra += f" (quarantined {res['resume_quarantined']})"
+                if res.get("worker_restarts"):
+                    extra += f" (worker restarts {res['worker_restarts']})"
+                verdict = (
+                    "recovered bit-identically"
+                    if res.get("recovered")
+                    else "surfaced the original exception"
+                )
+                print(f"{name}: {verdict}{extra}")
+            else:
+                why = res.get("error") or (
+                    "fingerprint diverged"
+                    if res.get("fingerprint_match") is False
+                    else "planted fault never fired"
+                    if res.get("fires") == 0
+                    else "failure semantics violated"
+                )
+                print(f"{name}: FAILED — {why}")
+    finally:
+        harness.close()
+
+    ok = all(r["ok"] for r in results)
+    summary = {
+        "chaos_audit": results,
+        "workload": {
+            "cells": args.cells, "genes": args.genes, "boots": args.boots,
+            "pcs": args.pcs, "seed": args.seed,
+        },
+        "ok": ok,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary, default=str))
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,16 @@ provenance: ``consensus/pipeline.py``'s ``*_ATTR`` constants (the regime /
 candidate_m / accumulated_pairs / pairs_ratio attrs on the candidates and
 cocluster spans) <-> ``obs.schema.CONSENSUS_SPAN_ATTRS``.
 
+Since ISSUE 10 it also covers the resilience layer:
+``resilience/inject.py``'s ``*_SITE`` constants <->
+``obs.schema.FAULT_SITES`` (both directions — every registered fault site
+must have a defining constant, every constant must be registered), and
+``tools/chaos_audit.py``'s site literals must be registered (not complete —
+the auditor consumes sites, it defines none). A renamed site is a test
+failure, not a chaos audit that silently stops covering a failure mode. The
+new retry/quarantine/supervision metric names ride the existing
+METRIC_HELP <-> METRIC_NAMES walk.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -74,6 +84,11 @@ METRIC_RE = re.compile(
 ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""")
 # obs/fingerprint.py checkpoint-name constants: NAME_CKPT = "literal"
 CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# resilience/inject.py fault-site constants: NAME_SITE = "literal"
+SITE_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_SITE)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# literal site names at fault-spec strings in tools/chaos_audit.py presets:
+# "site:kind[:arg]" — the first segment must be a registered fault site
+SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
 # literal checkpoint names at numeric_checkpoint(...) call sites (package
 # call sites import the *_CKPT constants, but a literal must still resolve)
 CKPT_CALL_RE = re.compile(
@@ -97,6 +112,9 @@ SCAN = (
     # ISSUE 8: the parity auditor consumes checkpoint streams by name — a
     # typo'd literal there would audit an always-empty stage
     os.path.join("tools", "parity_audit.py"),
+    # ISSUE 10: the chaos auditor plants faults by site name — a typo'd
+    # site there would "prove" resilience by never firing
+    os.path.join("tools", "chaos_audit.py"),
 )
 
 
@@ -238,6 +256,35 @@ def check_consensus_attrs(root: str) -> List[str]:
     )
 
 
+def check_fault_sites(root: str) -> List[str]:
+    """ISSUE 10: the fault-site registry, both directions.
+
+    * resilience/inject.py ``*_SITE`` literals <-> schema.FAULT_SITES
+      (complete: every registered site must have a defining constant — call
+      sites import these, so an unbacked registry entry means a site nothing
+      can plant);
+    * tools/chaos_audit.py fault-spec literals ("site:kind") must name
+      registered sites (not complete — the auditor consumes sites).
+    """
+    errors = _check_constant_registry(
+        root,
+        os.path.join("consensusclustr_tpu", "resilience", "inject.py"),
+        SITE_RE, "FAULT_SITES", "fault site", require_complete=True,
+    )
+    audit = os.path.join(root, "tools", "chaos_audit.py")
+    registry = getattr(schema, "FAULT_SITES", frozenset())
+    if os.path.isfile(audit):
+        with open(audit, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in SITE_SPEC_RE.finditer(line):
+                    if m.group(1) not in registry:
+                        errors.append(
+                            f"tools/chaos_audit.py:{lineno}: fault site "
+                            f"{m.group(1)!r} not in obs.schema.FAULT_SITES"
+                        )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
@@ -245,6 +292,7 @@ def check(root: str) -> List[str]:
         + check_resource_attrs(root)
         + check_numeric_registry(root)
         + check_consensus_attrs(root)
+        + check_fault_sites(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

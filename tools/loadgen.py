@@ -14,6 +14,10 @@ is the property a serving SLO needs: a closed loop self-throttles at
 saturation and reports flattering latencies; an open loop exposes the real
 queue growth, rejection rate, and tail. Backpressure rejections are counted,
 **not retried** (a retry would couple the arrival process to service state).
+Since ISSUE 10 each rejection carries the service's own ``retry_after_s``
+hint (queue depth over observed drain rate); the generator RECORDS the hints
+(``retry_after`` summary block: count seen / mean / max) but still never acts
+on them — the arrival process stays open-loop by design.
 
 Arrival processes (seeded, ``random.Random`` — reproducible):
 
@@ -229,6 +233,8 @@ def run_open_loop(
     failures = [0]
     pending = []
     rejected = 0
+    retry_hints: List[float] = []  # retry_after_s per rejection (recorded,
+    #                                never acted on — open loop)
     max_lag = 0.0
     t0 = time.perf_counter()
     for off in offsets:
@@ -241,8 +247,11 @@ def run_open_loop(
         t_sub = time.perf_counter()
         try:
             fut = svc.submit(q)
-        except RetryableRejection:
+        except RetryableRejection as e:
             rejected += 1
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None:
+                retry_hints.append(float(hint))
             continue
 
         def _done(f, t_sub=t_sub):
@@ -281,6 +290,14 @@ def run_open_loop(
         if submit_window > 0 else 0.0,
         "goodput_rps": round(completed / wall, 2) if wall > 0 else 0.0,
         "rejection_rate": round(rejected / submitted, 4) if submitted else 0.0,
+        # the service's backpressure hints, recorded only (ISSUE 10): how
+        # often a rejection carried retry_after_s and what it advised
+        "retry_after": {
+            "hinted": len(retry_hints),
+            "mean_s": round(sum(retry_hints) / len(retry_hints), 4)
+            if retry_hints else None,
+            "max_s": round(max(retry_hints), 4) if retry_hints else None,
+        },
         **_quantiles_ms(lat),
         "phase_parity": phase_parity(timings),
         "metrics_parity": metrics_parity(svc, lat),

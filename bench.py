@@ -62,6 +62,21 @@ fingerprint drifts between two same-schema rounds — a label-level numeric
 regression gate riding the existing bench trajectory. Setting CCTPU_NUMERICS
 additionally threads watch/audit checkpoints through the measured run
 itself.
+
+Work-ledger + noise accounting (obs schema v7, ISSUE 12): every rung also
+carries ``work_ledger`` (obs/ledger.py — total and per-top-level-phase
+deltas of the deterministic WORK_LEDGER_COUNTERS; same seeded workload =>
+same ledger on any host, however contended) and ``env_health`` (loadavg
+before/during/after the measured run, nproc, cgroup cpu quota when present,
+probe_s, and a fixed-work spin-calibration ``contention_ratio`` — the
+direct evidence when a wall number moved but the ledger did not). The
+default rung repeats its timed run (BENCH_WALL_TRIALS, default 3) and
+reports ``wall_trials`` (per-trial walls, median, MAD, robust CV) with
+``value``/``wall_s`` taken from the median, so every wall number carries
+its own error bar. ``tools/bench_diff.py --gate work`` gates the ledger
+exactly (any counter regression fails regardless of wall noise) while the
+wall gates are noise-aware; ``tools/perf_history.py`` renders the whole
+committed BENCH_*.json trajectory with ledger-vs-wall divergence notes.
 """
 
 from __future__ import annotations
@@ -107,13 +122,47 @@ _RETRY_FLAG = "CCTPU_BENCH_CPU_RETRY"
 _PROBE_CACHE: dict = {}
 
 # payload key -> process-global counter name (obs schema v3 dispatch
-# accounting + the v4 est_flops cost-model denominator)
-_DISPATCH_KEYS = {
+# accounting + the v4 est_flops cost-model denominator). Deduplicated
+# (ISSUE 12 satellite): the single source is obs/ledger.py
+# BENCH_DISPATCH_KEYS; the literal fallback keeps the failure rung emitting
+# when the package cannot import, and tools/check_obs_schema.py pins the
+# two copies equal and every counter name into METRIC_NAMES, both
+# directions.
+_DISPATCH_FALLBACK = {
     "device_dispatches": "device_dispatches",
     "executable_compiles": "executable_compiles",
     "donated_bytes": "donated_bytes",
     "est_flops": "estimated_flops",
 }
+try:
+    from consensusclustr_tpu.obs.ledger import (
+        BENCH_DISPATCH_KEYS as _DISPATCH_KEYS,
+    )
+except Exception:
+    _DISPATCH_KEYS = _DISPATCH_FALLBACK
+
+# Work-ledger counter order (obs schema v7): the deterministic counters the
+# ``work_ledger`` block carries on every rung. Same fallback contract as
+# _DISPATCH_KEYS — the literal is pinned to obs/ledger.py LEDGER_COUNTERS
+# by tools/check_obs_schema.py.
+_LEDGER_FALLBACK = (
+    "device_dispatches",
+    "executable_compiles",
+    "estimated_flops",
+    "estimated_bytes_accessed",
+    "donated_bytes",
+    "boots_completed",
+    "fault_injected",
+    "retry_attempts",
+    "retries_exhausted",
+    "ckpt_quarantined",
+)
+try:
+    from consensusclustr_tpu.obs.ledger import (
+        LEDGER_COUNTERS as _LEDGER_COUNTERS,
+    )
+except Exception:
+    _LEDGER_COUNTERS = _LEDGER_FALLBACK
 
 
 def _dispatch_counters() -> dict:
@@ -178,6 +227,154 @@ def _resource_rung(sampler) -> dict:
         pass
     return out
 
+def _work_ledger_zero() -> dict:
+    """The ``work_ledger`` zero shape: every registered counter at 0, no
+    phases — emitted on the failure rung so the work gate always has a
+    key-identical block to compare."""
+    return {"counters": {k: 0 for k in _LEDGER_COUNTERS}, "phases": {}}
+
+
+def _attach_ledger(tracer):
+    """obs/ledger.py attach, guarded for the failure ladder (a rung must
+    still emit when the obs layer cannot import)."""
+    try:
+        from consensusclustr_tpu.obs.ledger import attach_ledger
+
+        return attach_ledger(tracer)
+    except Exception:
+        return None
+
+
+def _work_ledger_block(tracer) -> dict:
+    """The tracer's harvested ledger summary, or the zero shape."""
+    try:
+        led = getattr(tracer, "work_ledger", None)
+        if led is not None:
+            return led.summary()
+    except Exception:
+        pass
+    return _work_ledger_zero()
+
+
+# The wall-trials zero shape (failure rung; the default rung emits the real
+# block, other configs measure one wall and omit it).
+_WALL_TRIALS_ZERO = {
+    "trials": 0,
+    "walls_s": [],
+    "median_s": 0.0,
+    "mad_s": 0.0,
+    "cv": 0.0,
+}
+
+
+def _wall_trials_block(walls) -> dict:
+    """Robust per-trial wall statistics: median, MAD, and the robust CV
+    (1.4826 * MAD / median — the normal-consistent scale estimate). CV is
+    the error bar tools/bench_diff.py's noise-aware wall gates read: a
+    regression on a high-CV rung with an unchanged ledger is contention
+    evidence, not a code regression."""
+    import statistics
+
+    med = statistics.median(walls)
+    mad = statistics.median([abs(w - med) for w in walls])
+    cv = (1.4826 * mad / med) if med > 0 else 0.0
+    return {
+        "trials": len(walls),
+        "walls_s": [round(w, 3) for w in walls],
+        "median_s": round(med, 3),
+        "mad_s": round(mad, 4),
+        "cv": round(cv, 4),
+    }
+
+
+def _wall_trial_count() -> int:
+    try:
+        return max(1, int(os.environ.get("BENCH_WALL_TRIALS", "3") or 3))
+    except ValueError:
+        return 3
+
+
+def _loadavg():
+    try:
+        return [round(x, 2) for x in os.getloadavg()]
+    except Exception:
+        return None
+
+
+def _cpu_quota():
+    """Effective cgroup CPU limit in cores (v2 cpu.max, then v1 cfs quota);
+    None when unbounded or unreadable — the CI-container evidence that
+    nproc overstates what the bench actually got."""
+    try:
+        with open("/sys/fs/cgroup/cpu.max") as f:
+            quota, period = f.read().split()[:2]
+        if quota != "max":
+            return round(int(quota) / int(period), 2)
+        return None
+    except Exception:
+        pass
+    try:
+        with open("/sys/fs/cgroup/cpu/cpu.cfs_quota_us") as f:
+            quota = int(f.read())
+        with open("/sys/fs/cgroup/cpu/cpu.cfs_period_us") as f:
+            period = int(f.read())
+        if quota > 0 and period > 0:
+            return round(quota / period, 2)
+    except Exception:
+        pass
+    return None
+
+
+def _spin_calibration(reps: int = 5, n: int = 200_000):
+    """Fixed-work spin reps: each rep executes the identical bytecode, so
+    wall per rep varies only with host contention. Returns (best_ms,
+    median/best ratio) — ratio ~1.0 on a quiet host, >1.5 under heavy
+    core-sharing."""
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        acc = 0
+        for i in range(n):
+            acc += i
+        walls.append((time.perf_counter() - t0) * 1000.0)
+    walls.sort()
+    best = walls[0]
+    med = walls[len(walls) // 2]
+    return best, (med / best if best > 0 else 0.0)
+
+
+class _EnvHealth:
+    """Environment-health telemetry bracketing the measured run (ISSUE 12):
+    loadavg before/during/after, nproc, cgroup quota, and the worse of two
+    spin-calibration contention readings (one before the run, one after).
+    Stdlib-only and exception-guarded throughout — the failure rung carries
+    the real block too (a failed round's contention evidence matters
+    most)."""
+
+    def __init__(self) -> None:
+        self._best0, self._ratio0 = _spin_calibration()
+        self._before = _loadavg()
+        self._during = None
+
+    def mark_after_run(self) -> None:
+        # os.getloadavg is a 1-minute EMA: read right after the workload it
+        # reflects the load *while* the run executed
+        self._during = _loadavg()
+
+    def block(self, probe_s: float) -> dict:
+        best1, ratio1 = _spin_calibration()
+        return {
+            "nproc": int(os.cpu_count() or 0),
+            "cpu_quota": _cpu_quota(),
+            "loadavg_before": self._before,
+            "loadavg_during": self._during or _loadavg(),
+            "loadavg_after": _loadavg(),
+            "probe_s": probe_s,
+            "spin_best_ms": round(min(self._best0, best1), 3),
+            "contention_ratio": round(max(self._ratio0, ratio1), 3),
+        }
+
+
 # The serving rung's zero shape — emitted verbatim on the failure rung so
 # BENCH_*.json lines stay key-comparable across PRs.
 _SERVING_ZERO = {
@@ -211,6 +408,7 @@ _SPARSE_CONSENSUS_ZERO = {
     "carry_mb": 0.0,
     "dense_equiv_mb": 0.0,
     "labels_fingerprint": None,
+    "work_ledger": _work_ledger_zero(),
 }
 
 
@@ -290,6 +488,9 @@ def _sparse_consensus_rung() -> dict:
             "carry_mb": round(n * m * 8 / 1e6, 2),
             "dense_equiv_mb": round(float(n) * n * 8 / 1e6, 2),
             "labels_fingerprint": _labels_fingerprint(res.labels),
+            # consensus_cluster attached the ledger to this rung's tracer
+            # (the direct-caller courtesy in consensus/pipeline.py)
+            "work_ledger": _work_ledger_block(tracer),
         }
     except Exception as e:
         return dict(_SPARSE_CONSENSUS_ZERO, error=str(e)[:200])
@@ -574,6 +775,13 @@ def _run_pbmc3k() -> dict:
         "ari_vs_truth": round(ari, 4),
         "boots_per_sec": round(nboots / dt, 3),
         "labels_fingerprint": _labels_fingerprint(res.assignments),
+        # api.consensus_clust attaches the ledger unconditionally; the
+        # RunRecord carries its harvested summary (schema v7)
+        "work_ledger": (
+            res.run_record.work_ledger
+            if res.run_record is not None and res.run_record.work_ledger
+            else _work_ledger_zero()
+        ),
         "phases": phases,
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(
@@ -624,6 +832,7 @@ def _run_granular() -> dict:
     key = root_key(123)
     pca_dev = jnp.asarray(pca)
     tracer = Tracer()
+    _attach_ledger(tracer)
     t0 = time.perf_counter()
     res = consensus_cluster(key, pca_dev, cfg, log=LevelLog(tracer=tracer))
     dt = time.perf_counter() - t0
@@ -641,6 +850,7 @@ def _run_granular() -> dict:
         "path": "blockwise",
         "boots_per_sec": round(nboots / dt, 3),
         "labels_fingerprint": _labels_fingerprint(res.labels),
+        "work_ledger": _work_ledger_block(tracer),
         "candidate_rows": b_eff,
         "n_clusters": int(res.n_clusters),
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
@@ -730,10 +940,25 @@ def _run() -> dict:
 
     run(Tracer())  # warmup: compiles the exact chunk shapes the timed run uses
 
-    tracer = Tracer()
-    t0 = time.perf_counter()
-    timed_labels = run(tracer)
-    dt = time.perf_counter() - t0
+    # Repeated-trial measurement (ISSUE 12): each trial reruns the identical
+    # post-warmup workload on a fresh tracer; the headline value/wall_s come
+    # from the MEDIAN wall and ``wall_trials`` carries the spread. The work
+    # ledger is harvested over trial 0 only, so its counters stay
+    # trial-count-independent (same workload => same ledger).
+    trials = _wall_trial_count()
+    walls = []
+    tracer = timed_labels = ledger_block = None
+    for t in range(trials):
+        tr = Tracer()
+        _attach_ledger(tr)
+        t0 = time.perf_counter()
+        labels = run(tr)
+        walls.append(time.perf_counter() - t0)
+        if t == 0:
+            tracer, timed_labels = tr, labels
+            ledger_block = _work_ledger_block(tr)
+    wall_trials = _wall_trials_block(walls)
+    dt = wall_trials["median_s"]
     boots_per_sec = nboots / dt
     # snapshot BEFORE the parity block below: its small dispatch also sets
     # LAST_PATH/LAST_VARIANT and could misattribute the timed number (e.g.
@@ -773,6 +998,8 @@ def _run() -> dict:
         "cells": n,
         "boots": nboots,
         "wall_s": round(dt, 3),
+        "wall_trials": wall_trials,
+        "work_ledger": ledger_block,
         # parity surface: the timed run's boot label rows (this rung has no
         # final consensus labels — the boot matrix IS its label output)
         "labels_fingerprint": _labels_fingerprint(timed_labels),
@@ -877,6 +1104,10 @@ def _await_healthy_backend() -> str:
 
 
 def main() -> None:
+    # env-health bracket (ISSUE 12): loadavg_before + the first spin
+    # calibration are read before the probe so they describe the host the
+    # whole round ran on, probe included
+    envh = _EnvHealth()
     # a parent bench process may have probed already (CPU-retry re-exec):
     # inherit its verdict and cost so this process reports them instead of 0
     probe_outcome = os.environ.get("CCTPU_BENCH_PROBE_VERDICT") or None
@@ -919,11 +1150,14 @@ def main() -> None:
         ballast = np.full(ballast_mb * 131072, 1.0)  # 131072 float64 = 1 MB
     try:
         payload = _run()
+        envh.mark_after_run()
         if probe_outcome is not None:
             payload["probe"] = probe_outcome
         # probe time is reported SEPARATELY from the measured run: wall_s /
         # value describe the workload, probe_s the environment's health check
         payload["probe_s"] = probe_s
+        payload["env_health"] = envh.block(probe_s)
+        payload.setdefault("work_ledger", _work_ledger_zero())
         payload.update(_dispatch_delta(dispatch0, _dispatch_counters()))
         payload.update(_resource_rung(sampler))
         del ballast
@@ -986,6 +1220,12 @@ def main() -> None:
                for k, v in _SERVING_SLO_ZERO.items()},
             "sparse_consensus": dict(_SPARSE_CONSENSUS_ZERO),
             "probe_s": probe_s,
+            # noise-proofing blocks keep their shape on failure too: real
+            # env_health (the contention evidence for the failed round),
+            # zero-shaped wall_trials and work_ledger
+            "env_health": envh.block(probe_s),
+            "wall_trials": dict(_WALL_TRIALS_ZERO),
+            "work_ledger": _work_ledger_zero(),
             **_dispatch_delta(dispatch0, _dispatch_counters()),
             **_resource_rung(sampler),
             "obs_schema": _OBS_SCHEMA,

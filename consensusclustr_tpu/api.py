@@ -871,6 +871,12 @@ def consensus_clust(
     # even materialising) its array, so the default path stays
     # dispatch-identical to a build without the layer.
     attach_numerics(tracer, cfg.numerics)
+    # Work ledger (obs/ledger.py, ISSUE 12): always on — one dict
+    # subtraction per root span buys the deterministic counter block every
+    # RunRecord.work_ledger and bench rung gates on.
+    from consensusclustr_tpu.obs.ledger import attach_ledger
+
+    attach_ledger(tracer)
     log = LevelLog(enabled=cfg.progress, tracer=tracer)
     key = root_key(cfg.seed)
 

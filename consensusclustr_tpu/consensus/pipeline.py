@@ -700,6 +700,12 @@ def consensus_cluster(
         from consensusclustr_tpu.obs.fingerprint import attach_numerics
 
         attach_numerics(_tr, cfg.numerics)
+    # Same courtesy for the work ledger (obs/ledger.py, ISSUE 12) —
+    # attach_ledger is idempotent, so an api-attached ledger is reused.
+    if _tr is not None:
+        from consensusclustr_tpu.obs.ledger import attach_ledger
+
+        attach_ledger(_tr)
 
     mesh = _resolve_mesh(cfg, n, log)
     if mesh is not None:

@@ -40,6 +40,16 @@ checkpoints in ``schema.NUMERIC_CHECKPOINTS`` under the opt-in
 ``audit``; off is genuinely free). The RunRecord carries the checkpoint
 stream (schema v6) and ``tools/parity_audit.py`` diffs two compute regimes'
 streams, naming the first divergent checkpoint.
+
+The work ledger (ISSUE 12 tentpole, ``obs/ledger.py``) is the deterministic
+side of every perf claim: ``WorkLedger`` assembles the
+``schema.WORK_LEDGER_COUNTERS`` (dispatches, compiles, estimated
+flops/bytes, donated bytes, boots, faults/retries) into total +
+per-top-level-phase deltas, attached unconditionally (one dict subtraction
+per root span) and stamped into ``RunRecord.work_ledger`` (schema v7).
+Same seeded workload ⇒ same ledger on any host — ``tools/bench_diff.py
+--gate work`` gates it exactly while wall gates are noise-aware, and
+``tools/perf_history.py`` renders the committed BENCH_*.json trajectory.
 """
 
 from consensusclustr_tpu.obs.export import (
@@ -58,6 +68,11 @@ from consensusclustr_tpu.obs.hist import (
     DEFAULT_BOUNDS,
     bucket_quantile,
     log_bounds,
+)
+from consensusclustr_tpu.obs.ledger import (
+    LEDGER_COUNTERS,
+    WorkLedger,
+    attach_ledger,
 )
 from consensusclustr_tpu.obs.metrics import (
     Histogram,
@@ -92,6 +107,7 @@ __all__ = [
     "DEFAULT_BOUNDS",
     "EVENT_KINDS",
     "Histogram",
+    "LEDGER_COUNTERS",
     "METRIC_NAMES",
     "MetricsRegistry",
     "NumericsMonitor",
@@ -101,7 +117,9 @@ __all__ = [
     "SPAN_NAMES",
     "Span",
     "Tracer",
+    "WorkLedger",
     "array_fingerprint",
+    "attach_ledger",
     "attach_numerics",
     "bucket_quantile",
     "chrome_trace_events",

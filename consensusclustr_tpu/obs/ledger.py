@@ -1,0 +1,161 @@
+"""Deterministic work ledger: the noise-proof side of every perf claim.
+
+Wall-clock on a shared CI host swings 0.17–1.1 boots/s on an identical
+workload (docs/perf.md history) — a wall number alone cannot distinguish a
+real regression from a busy neighbour. The pipeline itself is deterministic
+end to end (seeded boots, fingerprinted labels), and the instrumentation
+already counts the deterministic ingredients: ``counting_jit`` tallies
+dispatches/compiles/flops/bytes into the process-global registry, the
+pipeline counts boots into the tracer-local one. ``WorkLedger`` assembles
+exactly those counters (``obs.schema.WORK_LEDGER_COUNTERS``) into a
+per-run, per-top-level-phase block:
+
+    {"counters": {name: delta-since-attach},
+     "phases":   {root-span-name: {name: delta-while-that-phase-ran}}}
+
+Same seeded workload ⇒ same ledger, on any host, however contended — which
+is what makes it gateable exactly (``tools/bench_diff.py --gate work``: any
+counter regression fails regardless of wall noise) while wall gates get to
+be noise-aware. The block lands in ``RunRecord.work_ledger`` (schema v7)
+and on every bench rung including the failure payload.
+
+Attachment mirrors obs/resource.py's ResourceSampler: ``attach_ledger``
+hangs the ledger off the tracer (idempotent) and registers a span-close
+hook; per-phase attribution happens only at *root* span close (identity
+scan of ``tracer.roots``), so the hook is one dict subtraction per
+top-level phase — cheap enough to be always-on, unlike the opt-in sampler.
+
+Caveats the exactness contract lives with: counters harvested from the
+process-global registry (dispatches, compiles, …) see every thread in the
+process, so concurrent background work (the async checkpoint writer, a
+serving worker) lands in whatever phase is open when it increments — the
+totals stay exact, the per-phase split is attribution, not isolation. And
+``executable_compiles`` is deterministic only per process history: a warm
+persistent cache still traces (trace count is what the counter measures),
+but a second same-shape run in one process compiles 0. Bench rungs
+therefore measure the ledger over a fixed post-warmup trial.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from consensusclustr_tpu.obs.metrics import global_metrics
+from consensusclustr_tpu.obs.tracer import Tracer
+
+# The ledger's counter set. Each ``*_WORK`` literal is validated against
+# obs.schema.WORK_LEDGER_COUNTERS by tools/check_obs_schema.py, both
+# directions (and the set must be a subset of METRIC_NAMES) — a renamed
+# counter is a test failure, not a silently empty work gate.
+DISPATCHES_WORK = "device_dispatches"
+COMPILES_WORK = "executable_compiles"
+FLOPS_WORK = "estimated_flops"
+BYTES_WORK = "estimated_bytes_accessed"
+DONATED_WORK = "donated_bytes"
+BOOTS_WORK = "boots_completed"
+FAULTS_WORK = "fault_injected"
+RETRIES_WORK = "retry_attempts"
+EXHAUSTED_WORK = "retries_exhausted"
+QUARANTINED_WORK = "ckpt_quarantined"
+
+# Serialization order of the ledger (stable across runs and tools).
+LEDGER_COUNTERS = (
+    DISPATCHES_WORK,
+    COMPILES_WORK,
+    FLOPS_WORK,
+    BYTES_WORK,
+    DONATED_WORK,
+    BOOTS_WORK,
+    FAULTS_WORK,
+    RETRIES_WORK,
+    EXHAUSTED_WORK,
+    QUARANTINED_WORK,
+)
+
+# bench.py payload key -> ledger counter name, for the flat top-level keys
+# bench rungs have emitted since schema v3 (kept for trend continuity; the
+# structured block is ``work_ledger``). Single source of the mapping —
+# bench.py imports this under its guarded-import convention and
+# tools/check_obs_schema.py pins bench.py's fallback literal to it.
+BENCH_DISPATCH_KEYS = {
+    "device_dispatches": DISPATCHES_WORK,
+    "executable_compiles": COMPILES_WORK,
+    "donated_bytes": DONATED_WORK,
+    "est_flops": FLOPS_WORK,
+}
+
+
+class WorkLedger:
+    """Per-run deterministic work counters with top-level-phase attribution.
+
+    Reads each ``LEDGER_COUNTERS`` name from both registries feeding the
+    run (the process-global one counting_jit writes to, and the tracer's
+    run-local one the pipeline writes to) and tracks deltas: since attach
+    (``summary()["counters"]``) and per closed root span
+    (``summary()["phases"]``). Repeated root names (``level`` per pass)
+    accumulate. Never raises into the traced work.
+    """
+
+    def __init__(self, tracer: Tracer) -> None:
+        self._tracer = tracer
+        self._base = self._totals()
+        self._last = dict(self._base)
+        self._phases: Dict[str, Dict[str, int]] = {}
+
+    def _totals(self) -> Dict[str, int]:
+        vals: Dict[str, int] = {}
+        for name in LEDGER_COUNTERS:
+            total = 0.0
+            for reg in (global_metrics(), self._tracer.metrics):
+                c = reg.counters.get(name)
+                if c is not None:
+                    total += c.value
+            vals[name] = int(total)
+        return vals
+
+    def on_span_close(self, span: Any) -> None:
+        """Span-close hook: attribute the counter delta since the previous
+        root close to this root span's name. Child spans are ignored —
+        attribution is per top-level phase, matching ``phase_seconds``."""
+        try:
+            if not any(span is r for r in self._tracer.roots):
+                return
+            now = self._totals()
+            phase = self._phases.setdefault(
+                span.name, {k: 0 for k in LEDGER_COUNTERS}
+            )
+            for k in LEDGER_COUNTERS:
+                phase[k] += max(0, now[k] - self._last[k])
+            self._last = now
+        except Exception:
+            pass  # observability must never fail the traced work
+
+    def summary(self) -> dict:
+        """JSON-able ledger block: total deltas since attach + the per-phase
+        attribution collected so far."""
+        now = self._totals()
+        return {
+            "counters": {
+                k: max(0, now[k] - self._base[k]) for k in LEDGER_COUNTERS
+            },
+            "phases": {
+                name: dict(vals) for name, vals in self._phases.items()
+            },
+        }
+
+
+def attach_ledger(tracer: Optional[Tracer]) -> Optional[WorkLedger]:
+    """Hang a WorkLedger off ``tracer`` (idempotent — an already-attached
+    ledger is returned as-is) and register its root-span-close hook.
+    ``RunRecord.from_tracer`` harvests ``tracer.work_ledger.summary()``
+    into the record's ``work_ledger`` block. None-safe for tracer-less
+    callers."""
+    if tracer is None:
+        return None
+    existing = getattr(tracer, "work_ledger", None)
+    if isinstance(existing, WorkLedger):
+        return existing
+    ledger = WorkLedger(tracer)
+    tracer.work_ledger = ledger  # type: ignore[attr-defined]
+    tracer.add_span_close_hook(ledger.on_span_close)
+    return ledger

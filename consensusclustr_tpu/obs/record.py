@@ -87,6 +87,12 @@ class RunRecord:
     # stream tools/parity_audit.py diffs across regimes. None on older
     # records and on runs with numerics off (the default).
     numerics: Optional[dict] = None
+    # schema v7: deterministic work ledger (obs/ledger.py WorkLedger
+    # summary) — total WORK_LEDGER_COUNTERS deltas since attach plus the
+    # per-top-level-phase attribution. None only on older records; current
+    # runs attach the ledger unconditionally (it is one dict subtraction
+    # per root span).
+    work_ledger: Optional[dict] = None
 
     @classmethod
     def from_tracer(
@@ -116,6 +122,13 @@ class RunRecord:
                 numerics = monitor.summary()
             except Exception:
                 numerics = None
+        ledger = getattr(tracer, "work_ledger", None)
+        work_ledger = None
+        if ledger is not None:
+            try:
+                work_ledger = ledger.summary()
+            except Exception:
+                work_ledger = None
         return cls(
             schema=SCHEMA_VERSION,
             backend=backend,
@@ -127,6 +140,7 @@ class RunRecord:
             config=_config_dict(config),
             resource=resource,
             numerics=numerics,
+            work_ledger=work_ledger,
         )
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -153,6 +167,8 @@ class RunRecord:
             d["resource"] = self.resource
         if self.numerics is not None:
             d["numerics"] = self.numerics
+        if self.work_ledger is not None:
+            d["work_ledger"] = self.work_ledger
         return d
 
     def to_json(self) -> str:
@@ -196,6 +212,7 @@ class RunRecord:
             config=d.get("config"),
             resource=d.get("resource"),
             numerics=d.get("numerics"),
+            work_ledger=d.get("work_ledger"),
         )
 
 

@@ -67,7 +67,20 @@ from __future__ import annotations
 # ``retry_backoff_seconds`` histogram are new names with no change to any
 # existing one; the RunRecord layout is untouched. See docs/quirks.md
 # "Fault injection, retries and checkpoint integrity".
-SCHEMA_VERSION = 6
+# v7 (ISSUE 12): deterministic work ledger — RunRecord gained the
+# ``work_ledger`` block (obs/ledger.py WorkLedger summary: total counter
+# deltas since attach plus a per-top-level-phase attribution of the
+# WORK_LEDGER_COUNTERS below, harvested at root-span close). Every bench
+# rung — including the failure payload — now carries ``work_ledger``,
+# ``env_health`` (loadavg before/during/after, nproc, cgroup cpu quota,
+# probe_s, spin-calibration contention ratio) and, on the default rung,
+# ``wall_trials`` (per-trial walls, median, MAD, robust CV). The ledger is
+# the deterministic side of every perf claim: tools/bench_diff.py gates it
+# exactly (``--gate work``) while wall gates became noise-aware, and
+# tools/perf_history.py walks the committed BENCH_*.json series with
+# ledger-vs-wall divergence annotations. See docs/quirks.md
+# "Observability schema v6 → v7".
+SCHEMA_VERSION = 7
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -260,6 +273,30 @@ FAULT_SITES = frozenset({
     "serve_batch",    # micro-batch device execution (serve/service.py)
     "serve_warmup",   # per-bucket warm-up compile dispatch
     "serve_worker",   # the serving worker loop itself (supervised restart)
+})
+
+# Deterministic work-ledger counters (ISSUE 12): the subset of METRIC_NAMES
+# that measures *work dispatched*, not time — identical across reruns of the
+# same seeded workload on any host, however contended. obs/ledger.py's
+# WorkLedger harvests exactly these into RunRecord.work_ledger and the bench
+# ``work_ledger`` block, and tools/bench_diff.py gates them exactly
+# (``--gate work``: any counter regression fails regardless of wall noise).
+# tools/check_obs_schema.py validates the ``*_WORK`` literals in
+# obs/ledger.py against this set, both directions, that every name here is
+# a registered metric (subset of METRIC_NAMES), and that bench.py's guarded
+# fallback literals match obs/ledger.py — a renamed counter is a test
+# failure, not a silently empty work gate.
+WORK_LEDGER_COUNTERS = frozenset({
+    "device_dispatches",        # top-level executable launches
+    "executable_compiles",      # traces (one per shape bucket)
+    "estimated_flops",          # summed XLA cost_analysis flops
+    "estimated_bytes_accessed", # summed XLA cost_analysis bytes
+    "donated_bytes",            # operand bytes donated in place
+    "boots_completed",          # bootstraps actually computed
+    "fault_injected",           # planted faults that fired (0 in production)
+    "retry_attempts",           # fault-site attempts retried
+    "retries_exhausted",        # fault-site calls that gave up
+    "ckpt_quarantined",         # corrupt checkpoint chunks set aside
 })
 
 # Span attrs stamped by consensus/pipeline.py on the candidates/cocluster

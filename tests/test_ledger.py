@@ -1,0 +1,484 @@
+"""ISSUE 12 — deterministic work ledger + noise-aware bench.
+
+Covers the tentpole end to end:
+
+* obs/ledger.py: WorkLedger attach/idempotence, per-phase attribution at
+  span close, RunRecord v7 round-trip, and the headline determinism
+  contract — identical counters across pipeline depths 1/2/4 AND the
+  fused:looped grid pair (wall clocks differ; the ledger must not);
+* bench.py: failure-rung zero shapes stay key-identical to real blocks,
+  and the fallback literals stay pinned to obs.ledger;
+* tools/bench_diff.py: the --gate work exact gate plus the noise-aware
+  wall-gate matrix (ledger regression => exit 3; wall regression with
+  high trial CV and identical ledger => WARN, exit 0; wall regression
+  with tight CV on both sides => exit 3);
+* tools/perf_history.py: trend over the committed BENCH_rNN series
+  (failed rounds included), the same-schema adjacency gate, and the
+  schema-bump fence;
+* schema registry: *_WORK constants <-> WORK_LEDGER_COUNTERS both ways,
+  subset-of-METRIC_NAMES, and the ast pin on bench.py's fallbacks;
+* tools/report.py: the "== work ==" table;
+* CI wiring: perf_history --check and bench_diff --check --gate work run
+  clean over the committed artifacts, as the bench flow invokes them.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import run_bootstraps
+from consensusclustr_tpu.obs import RunRecord, Tracer
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.obs.ledger import (
+    BENCH_DISPATCH_KEYS,
+    LEDGER_COUNTERS,
+    WorkLedger,
+    attach_ledger,
+)
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import root_key
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO_ROOT, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rc(mod, argv):
+    """main() return code, SystemExit-tolerant (BenchDiffError raises)."""
+    try:
+        return mod.main(argv)
+    except SystemExit as e:
+        return e.code
+
+
+# -----------------------------------------------------------------------------
+# the ledger core
+# -----------------------------------------------------------------------------
+
+
+class TestWorkLedgerCore:
+    def test_attach_idempotent(self):
+        tr = Tracer()
+        led = attach_ledger(tr)
+        assert isinstance(led, WorkLedger)
+        assert attach_ledger(tr) is led
+        assert tr.work_ledger is led
+        assert attach_ledger(None) is None
+
+    def test_registry_matches_constants(self):
+        assert set(LEDGER_COUNTERS) == set(obs_schema.WORK_LEDGER_COUNTERS)
+        # the ledger only sums series the metrics registry owns
+        assert obs_schema.WORK_LEDGER_COUNTERS <= obs_schema.METRIC_NAMES
+
+    def test_summary_shape_and_zero_baseline(self):
+        led = attach_ledger(Tracer())
+        s = led.summary()
+        assert set(s) == {"counters", "phases"}
+        assert tuple(s["counters"]) == LEDGER_COUNTERS
+        assert s["phases"] == {}
+
+    def test_phase_attribution_root_spans_only(self):
+        tr = Tracer()
+        led = attach_ledger(tr)
+        with tr.span("ingest"):
+            tr.metrics.counter("boots_completed").inc(2)
+            with tr.span("inner"):  # child span must NOT get its own phase
+                tr.metrics.counter("boots_completed").inc(1)
+        with tr.span("consensus"):
+            tr.metrics.counter("retry_attempts").inc(1)
+        s = led.summary()
+        assert set(s["phases"]) == {"ingest", "consensus"}
+        assert s["phases"]["ingest"]["boots_completed"] == 3
+        assert s["phases"]["consensus"]["retry_attempts"] == 1
+        assert s["counters"]["boots_completed"] == 3
+        assert s["counters"]["retry_attempts"] == 1
+
+    def test_record_round_trip_v7(self, tmp_path):
+        tr = Tracer()
+        attach_ledger(tr)
+        with tr.span("boots"):
+            tr.metrics.counter("boots_completed").inc(4)
+        rec = RunRecord.from_tracer(tr)
+        assert rec.schema == 7
+        assert rec.work_ledger is not None
+        assert rec.work_ledger["counters"]["boots_completed"] == 4
+        path = str(tmp_path / "rec.jsonl")
+        rec.write(path)
+        from consensusclustr_tpu.obs import load_records
+
+        back = load_records(path)[-1]
+        assert back.work_ledger == rec.work_ledger
+
+    def test_ledger_deterministic_across_depths_and_grid_impls(self):
+        """The headline contract: pipeline depth changes WHEN work runs
+        (wall clock moves), the fused:looped pair changes WHICH executable
+        runs — neither may move a single deterministic counter. One warmup
+        per variant absorbs the compile-time counters (compiles/flops are
+        counted at compile, so post-warmup trials show the steady state
+        bench.py's wall_trials measures)."""
+        rng = np.random.default_rng(0)
+        pca = rng.normal(size=(48, 3)).astype(np.float32)
+
+        def measure(depth=None, impl=None):
+            old = os.environ.pop("CCTPU_GRID_IMPL", None)
+            try:
+                if impl is not None:
+                    os.environ["CCTPU_GRID_IMPL"] = impl
+                cfg = ClusterConfig(
+                    nboots=4, k_num=(5,), res_range=(0.2,), max_clusters=16,
+                    boot_batch=2, pipeline_depth=depth,
+                )
+                run_bootstraps(root_key(3), pca, cfg)  # warmup: compiles
+                tr = Tracer()
+                led = attach_ledger(tr)
+                with tr.span("boots"):
+                    run_bootstraps(
+                        root_key(3), pca, cfg, log=LevelLog(tracer=tr)
+                    )
+                return led.summary()["counters"]
+            finally:
+                os.environ.pop("CCTPU_GRID_IMPL", None)
+                if old is not None:
+                    os.environ["CCTPU_GRID_IMPL"] = old
+
+        baseline = measure(depth=1)
+        assert baseline["device_dispatches"] > 0
+        assert baseline["boots_completed"] == 4
+        for depth in (2, 4):
+            assert measure(depth=depth) == baseline, f"depth {depth} moved"
+        for impl in ("fused", "looped"):
+            assert measure(impl=impl) == baseline, f"{impl} moved"
+
+
+# -----------------------------------------------------------------------------
+# bench.py blocks: zero shapes + fallback pinning
+# -----------------------------------------------------------------------------
+
+
+class TestBenchBlocks:
+    def test_zero_ledger_key_parity(self):
+        bench = _load_bench()
+        zero = bench._work_ledger_zero()
+        assert set(zero["counters"]) == set(LEDGER_COUNTERS)
+        assert all(v == 0 for v in zero["counters"].values())
+        assert zero["phases"] == {}
+        # identical key set to a real summary
+        assert set(zero["counters"]) == set(
+            attach_ledger(Tracer()).summary()["counters"]
+        )
+
+    def test_wall_trials_zero_key_parity(self):
+        bench = _load_bench()
+        real = bench._wall_trials_block([0.1, 0.2, 0.3])
+        assert set(bench._WALL_TRIALS_ZERO) == set(real)
+        assert real["trials"] == 3 and real["median_s"] == 0.2
+        assert real["cv"] > 0
+
+    def test_fallbacks_pinned_to_ledger(self):
+        bench = _load_bench()
+        assert bench._DISPATCH_KEYS == BENCH_DISPATCH_KEYS
+        assert tuple(bench._LEDGER_COUNTERS) == tuple(LEDGER_COUNTERS)
+        assert bench._DISPATCH_FALLBACK == dict(BENCH_DISPATCH_KEYS)
+        assert bench._LEDGER_FALLBACK == tuple(LEDGER_COUNTERS)
+
+    def test_env_health_block_shape(self):
+        bench = _load_bench()
+        envh = bench._EnvHealth()
+        envh.mark_after_run()
+        block = envh.block(1.25)
+        assert set(block) >= {
+            "nproc", "cpu_quota", "loadavg_before", "loadavg_during",
+            "loadavg_after", "probe_s", "spin_best_ms", "contention_ratio",
+        }
+        assert block["probe_s"] == 1.25
+        assert block["contention_ratio"] >= 1.0
+
+
+# -----------------------------------------------------------------------------
+# bench_diff: the work gate + the noise-aware wall-gate matrix
+# -----------------------------------------------------------------------------
+
+
+def _payload(value=10.0, wall=1.0, cv=0.15, dispatches=7, schema=7):
+    counters = {k: 0 for k in LEDGER_COUNTERS}
+    counters.update(
+        device_dispatches=dispatches, executable_compiles=5,
+        boots_completed=8,
+    )
+    return {
+        "metric": "boots_per_sec", "value": value, "unit": "boots/s",
+        "obs_schema": schema, "wall_s": wall,
+        "work_ledger": {"counters": counters, "phases": {}},
+        "wall_trials": {
+            "trials": 3, "walls_s": [wall] * 3, "median_s": wall,
+            "mad_s": cv * wall / 1.4826, "cv": cv,
+        },
+    }
+
+
+class TestNoiseAwareGates:
+    def _pair(self, tmp_path, old, new):
+        a, b = tmp_path / "BENCH_a.json", tmp_path / "BENCH_b.json"
+        a.write_text(json.dumps(old))
+        b.write_text(json.dumps(new))
+        return str(a), str(b)
+
+    def test_work_gate_exact_on_counter_growth(self, tmp_path, capsys):
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(tmp_path, _payload(), _payload(dispatches=9))
+        assert _rc(bd, [a, b, "--gate", "work"]) == 3
+        err = capsys.readouterr().err
+        assert "work_ledger.device_dispatches" in err
+        assert "7 -> 9" in err
+
+    def test_work_gate_passes_wall_only_slowdown(self, tmp_path):
+        """The acceptance scenario: a synthetic wall-only slowdown (same
+        ledger, 3x the wall) passes the work gate clean."""
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(
+            tmp_path, _payload(wall=1.0, value=10.0),
+            _payload(wall=3.0, value=3.3),
+        )
+        assert _rc(bd, [a, b, "--gate", "work"]) == 0
+
+    def test_work_gate_factor_allows_slack(self, tmp_path):
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(tmp_path, _payload(), _payload(dispatches=9))
+        assert _rc(bd, [a, b, "--gate", "work:1.5"]) == 0
+
+    def test_work_gate_bad_spec(self, tmp_path):
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(tmp_path, _payload(), _payload())
+        assert _rc(bd, [a, b, "--gate", "work:abc"]) == 1
+
+    def test_wall_regression_high_cv_identical_ledger_excused(
+        self, tmp_path, capsys
+    ):
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(
+            tmp_path, _payload(value=10.0, cv=0.2),
+            _payload(value=5.0, cv=0.2),
+        )
+        assert _rc(bd, [a, b, "--gate", "value:0.9", "--gate", "work"]) == 0
+        assert "NOISE value" in capsys.readouterr().err
+
+    def test_wall_regression_tight_cv_both_sides_gates(self, tmp_path):
+        """Low CV on BOTH sides = both measurements trustworthy, so the
+        wall regression is real even with an identical ledger."""
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(
+            tmp_path, _payload(value=10.0, cv=0.02),
+            _payload(value=5.0, cv=0.01),
+        )
+        assert _rc(bd, [a, b, "--gate", "value:0.9"]) == 3
+
+    def test_wall_regression_loose_cv_one_side_still_excused(self, tmp_path):
+        """max(cv_old, cv_new) semantics: a loose measurement on EITHER
+        side makes the wall comparison untrustworthy."""
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(
+            tmp_path, _payload(value=10.0, cv=0.25),
+            _payload(value=5.0, cv=0.01),
+        )
+        assert _rc(bd, [a, b, "--gate", "value:0.9"]) == 0
+
+    def test_wall_regression_changed_ledger_not_excused(self, tmp_path):
+        """High CV does NOT excuse a wall regression when the ledger moved
+        — more work was dispatched, so the slowdown has a code reason."""
+        bd = _load_tool("bench_diff")
+        a, b = self._pair(
+            tmp_path, _payload(value=10.0, cv=0.2),
+            _payload(value=5.0, cv=0.2, dispatches=9),
+        )
+        assert _rc(bd, [a, b, "--gate", "value:0.9"]) == 3
+
+    def test_check_mode_old_side_predates_ledger(self, tmp_path, capsys):
+        """--check + an old payload without a work_ledger block (schema < 7)
+        warns and skips the work gate instead of failing — committed
+        history cannot retroactively grow the block. The v6 -> v7 schema
+        bump rides the same adjacent-bump fence."""
+        bd = _load_tool("bench_diff")
+        old = _payload(schema=6)
+        del old["work_ledger"], old["wall_trials"]
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(_payload()))
+        assert _rc(
+            bd, ["--check", "--dir", str(tmp_path), "--gate", "work"]
+        ) == 0
+        err = capsys.readouterr().err
+        assert "predates the work ledger" in err
+
+    def test_file_pair_missing_ledger_is_loud(self, tmp_path):
+        """Outside --check/--latest a missing work_ledger is an input
+        error (exit 1), not a silent pass."""
+        bd = _load_tool("bench_diff")
+        old = _payload()
+        del old["work_ledger"]
+        a, b = self._pair(tmp_path, old, _payload())
+        assert _rc(bd, [a, b, "--gate", "work"]) == 1
+
+
+# -----------------------------------------------------------------------------
+# perf_history: the committed series + the adjacency gate
+# -----------------------------------------------------------------------------
+
+
+class TestPerfHistory:
+    def test_committed_series_renders_every_round(self):
+        ph = _load_tool("perf_history")
+        rows = ph.collect(REPO_ROOT)
+        rounds = {r["round"] for r in rows}
+        # the full committed trajectory, failed rounds included
+        assert {1, 2, 3, 4, 5, 6, 7, 9, 12} <= rounds
+        failed = [r for r in rows if r["payload"] is None]
+        assert {r["round"] for r in failed} >= {1, 2}
+        assert all("failed round" in r["note"] for r in failed)
+        table = ph.trend_table(rows)
+        assert len(table.splitlines()) >= len(rows) + 2
+        assert "note" in table.splitlines()[0]
+
+    def test_committed_series_has_no_ledger_regression(self):
+        ph = _load_tool("perf_history")
+        rows = ph.collect(REPO_ROOT)
+        assert ph.ledger_regressions(rows) == []
+
+    def test_r12_carries_v7_blocks(self):
+        """The freshly committed r12 artifact is the first schema v7 round:
+        structured ledger, wall trials, env health — all present."""
+        ph = _load_tool("perf_history")
+        rows = {r["round"]: r for r in ph.collect(REPO_ROOT)}
+        p = rows[12]["payload"]
+        assert p is not None and p["obs_schema"] == 7
+        assert set(p["work_ledger"]["counters"]) == set(LEDGER_COUNTERS)
+        assert p["wall_trials"]["trials"] >= 1
+        assert p["env_health"]["contention_ratio"] >= 1.0
+
+    def test_synthetic_regression_series_gates(self, tmp_path, capsys):
+        ph = _load_tool("perf_history")
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "parsed": _payload(dispatches=7)}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "rc": 0, "parsed": _payload(dispatches=9)}))
+        rows = ph.collect(str(tmp_path))
+        regs = ph.ledger_regressions(rows)
+        assert regs and "device_dispatches grew 7 -> 9" in regs[0]
+        assert ph.main(["--dir", str(tmp_path), "--check"]) == 3
+        assert "LEDGER REGRESSION" in capsys.readouterr().err
+
+    def test_schema_bump_fences_adjacency(self, tmp_path):
+        ph = _load_tool("perf_history")
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "parsed": _payload(dispatches=7, schema=6)}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "rc": 0, "parsed": _payload(dispatches=9, schema=7)}))
+        rows = ph.collect(str(tmp_path))
+        assert ph.ledger_regressions(rows) == []
+        assert ph.main(["--dir", str(tmp_path), "--check"]) == 0
+
+    def test_flat_fallback_ledger(self):
+        """Pre-v7 payloads contribute their flat dispatch keys as the
+        fallback ledger, mapped onto counter names."""
+        ph = _load_tool("perf_history")
+        led = ph.ledger_of({
+            "metric": "m", "device_dispatches": 4, "executable_compiles": 2,
+            "est_flops": 1e9, "donated_bytes": 512,
+        })
+        assert led == {
+            "device_dispatches": 4, "executable_compiles": 2,
+            "estimated_flops": 1e9, "donated_bytes": 512,
+        }
+        assert ph.ledger_of({"metric": "m"}) is None
+
+    def test_host_noise_annotation(self, tmp_path):
+        """Identical ledger + 3x wall => the 'host noise' verdict the
+        whole PR exists to make mechanical."""
+        ph = _load_tool("perf_history")
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "parsed": _payload(wall=1.0)}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "rc": 0, "parsed": _payload(wall=3.0)}))
+        rows = ph.collect(str(tmp_path))
+        table = ph.trend_table(rows)
+        assert "host noise" in table
+
+
+# -----------------------------------------------------------------------------
+# schema registry + report table + CI wiring
+# -----------------------------------------------------------------------------
+
+
+class TestSchemaAndReport:
+    def test_work_registry_both_ways(self):
+        check = _load_tool("check_obs_schema")
+        assert hasattr(check, "check_work_ledger")
+        assert check.check_work_ledger(REPO_ROOT) == []
+        assert check.check(REPO_ROOT) == []
+
+    def test_rogue_work_constant_caught(self, tmp_path):
+        check = _load_tool("check_obs_schema")
+        pkg = tmp_path / "consensusclustr_tpu" / "obs"
+        pkg.mkdir(parents=True)
+        (pkg / "ledger.py").write_text('ROGUE_WORK = "not_a_counter"\n')
+        errors = check.check_work_ledger(str(tmp_path))
+        assert any("not_a_counter" in e for e in errors)
+
+    def test_report_work_table(self):
+        report = _load_tool("report")
+        assert 7 in report.KNOWN_SCHEMAS
+        rec = {
+            "schema": 7,
+            "work_ledger": {
+                "counters": {k: 0 for k in LEDGER_COUNTERS}
+                | {"device_dispatches": 3, "boots_completed": 2},
+                "phases": {"boots": {"device_dispatches": 3}},
+            },
+        }
+        out = report.work(rec)
+        assert "boots" in out and "(total)" in out and "disp" in out
+        assert "no work ledger" in report.work({"schema": 5})
+        assert "== work ==" in report.render({"spans": [], "events": []})
+
+    def test_ci_wiring_perf_history_check(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "perf_history.py"), "--check",
+             "--dir", REPO_ROOT],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "perf_history: ok" in proc.stdout
+
+    def test_ci_wiring_bench_diff_work_gate(self):
+        """The bench flow's committed-pair gate: r09 (v6) -> r12 (v7) is an
+        adjacent bump with the old side predating the ledger — both
+        relaxations warn, exit stays 0."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "bench_diff.py"), "--check",
+             "--dir", REPO_ROOT, "--gate", "work"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "bench_diff: ok" in proc.stdout

@@ -633,10 +633,16 @@ class TestBenchRung:
         assert 0.0 < sc["pairs_ratio"] < 1.0 / 8.0
 
     def test_zero_shape_matches_committed_keys(self):
-        """The failure rung stays key-comparable with a real rung."""
+        """The failure rung stays key-comparable with a real rung: exact
+        key parity with the newest committed round (r12, schema v7 — the
+        sparse block gained ``work_ledger``), superset of the pre-ledger
+        r09 block."""
         bench = self._bench()
         sc = self._committed()["sparse_consensus"]
-        assert set(bench._SPARSE_CONSENSUS_ZERO) == set(sc)
+        assert set(bench._SPARSE_CONSENSUS_ZERO) >= set(sc)
+        doc = json.load(open(os.path.join(REPO_ROOT, "BENCH_r12.json")))
+        sc12 = doc["parsed"]["sparse_consensus"]
+        assert set(bench._SPARSE_CONSENSUS_ZERO) == set(sc12)
 
     def test_check_mode_accepts_committed_pair(self):
         """bench_diff --check over the newest committed pair (r07 schema 5 ->

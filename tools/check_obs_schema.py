@@ -53,6 +53,14 @@ failure, not a chaos audit that silently stops covering a failure mode. The
 new retry/quarantine/supervision metric names ride the existing
 METRIC_HELP <-> METRIC_NAMES walk.
 
+Since ISSUE 12 the work ledger rides the same rails: ``obs/ledger.py``'s
+``*_WORK`` constants <-> ``obs.schema.WORK_LEDGER_COUNTERS`` (both
+directions), the registry pinned as a subset of METRIC_NAMES, and the
+import-failure fallback literals in bench.py (``_DISPATCH_FALLBACK`` /
+``_LEDGER_FALLBACK``) plus tools/perf_history.py's ``FLAT_LEDGER_KEYS``
+ast-pinned to obs.ledger — the bench failure payload must stay
+key-identical to real rungs even when the package cannot import.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -86,6 +94,8 @@ ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""
 CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""")
 # resilience/inject.py fault-site constants: NAME_SITE = "literal"
 SITE_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_SITE)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# obs/ledger.py work-counter constants: NAME_WORK = "literal"
+WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""")
 # literal site names at fault-spec strings in tools/chaos_audit.py presets:
 # "site:kind[:arg]" — the first segment must be a registered fault site
 SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
@@ -285,6 +295,82 @@ def check_fault_sites(root: str) -> List[str]:
     return errors
 
 
+def _literal_assign(path: str, name: str):
+    """The literal value of a module-level ``name = <literal>`` assignment in
+    ``path`` (via ast — the file is never imported), or None when absent or
+    non-literal."""
+    import ast
+
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if name in targets:
+                try:
+                    return ast.literal_eval(node.value)
+                except ValueError:
+                    return None
+    return None
+
+
+def check_work_ledger(root: str) -> List[str]:
+    """ISSUE 12: the work-ledger registry, three ways.
+
+    * obs/ledger.py ``*_WORK`` literals <-> schema.WORK_LEDGER_COUNTERS
+      (complete: every registered counter must have a defining constant —
+      the ledger harvests by these names, so an unbacked registry entry is
+      a counter nothing sums);
+    * WORK_LEDGER_COUNTERS must be a subset of METRIC_NAMES — the ledger
+      only sums counters the metrics registry already owns, so a ledger
+      entry outside METRIC_NAMES would read a series nothing increments;
+    * bench.py's import-failure fallbacks (``_DISPATCH_FALLBACK`` /
+      ``_LEDGER_FALLBACK``) and tools/perf_history.py's
+      ``FLAT_LEDGER_KEYS`` are pinned (via ast, never imported) to
+      obs.ledger's ``BENCH_DISPATCH_KEYS`` / ``LEDGER_COUNTERS`` — the
+      failure-payload rung must stay key-identical to the real rungs even
+      when the package cannot import. Roots without bench.py (the
+      synthetic trees the tests build) skip the pinning.
+    """
+    errors = _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "obs", "ledger.py"),
+        WORK_RE, "WORK_LEDGER_COUNTERS", "work counter", require_complete=True,
+    )
+    registry = getattr(schema, "WORK_LEDGER_COUNTERS", None)
+    if registry is not None:
+        for name in sorted(set(registry) - schema.METRIC_NAMES):
+            errors.append(
+                f"obs/schema.py: WORK_LEDGER_COUNTERS entry {name!r} not in "
+                "METRIC_NAMES (the ledger would sum a series nothing "
+                "increments)"
+            )
+    if not os.path.isfile(
+        os.path.join(root, "consensusclustr_tpu", "obs", "ledger.py")
+    ):
+        return errors
+    try:
+        from consensusclustr_tpu.obs import ledger
+    except Exception as e:  # pragma: no cover - import breakage is its own bug
+        return errors + [f"obs/ledger.py: import failed ({e})"]
+    pins = (
+        ("bench.py", "_DISPATCH_FALLBACK", dict(ledger.BENCH_DISPATCH_KEYS)),
+        ("bench.py", "_LEDGER_FALLBACK", tuple(ledger.LEDGER_COUNTERS)),
+        (os.path.join("tools", "perf_history.py"), "FLAT_LEDGER_KEYS",
+         dict(ledger.BENCH_DISPATCH_KEYS)),
+    )
+    for rel, const, want in pins:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        got = _literal_assign(path, const)
+        if got != want:
+            errors.append(
+                f"{rel}: {const} drifted from obs.ledger "
+                f"(got {got!r}, expected {want!r})"
+            )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
@@ -293,6 +379,7 @@ def check(root: str) -> List[str]:
         + check_numeric_registry(root)
         + check_consensus_attrs(root)
         + check_fault_sites(root)
+        + check_work_ledger(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

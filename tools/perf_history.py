@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Perf-history trend: render every committed BENCH_*.json as one trajectory.
+
+Usage:
+    python tools/perf_history.py [--dir ROOT]    # trend table (all rounds)
+    python tools/perf_history.py --check         # CI: gate ledger regressions
+    python tools/perf_history.py --json          # machine-readable rows
+
+The repo root accumulates one BENCH_rNN.json per PR round (the driver
+wrapper: {"n", "cmd", "rc", "tail", "parsed"}), but until now the pile was
+dead weight — eight artifacts and no way to read them as a series. This
+tool walks them all, tolerating every era of the format:
+
+  * failed rounds (r01/r02: rc != 0, empty ``parsed``, no JSON line in
+    ``tail``) render as explicit failed rows — never a crash, never
+    silently dropped;
+  * pre-schema payloads (r03–r05: no ``obs_schema`` stamp) render with
+    schema "-" and whatever rungs they carry;
+  * schema v3+ payloads contribute the flat dispatch counters
+    (``device_dispatches`` / ``executable_compiles`` / ``est_flops`` /
+    ``donated_bytes``) as a fallback ledger;
+  * schema v7 payloads contribute the real ``work_ledger.counters`` block
+    plus ``wall_trials.cv``.
+
+Each row gets a divergence note comparing it to the previous payload row:
+a wall that moved >= 1.5x while the ledger stayed identical is annotated
+"=> host noise" (the deterministic work did not change, so the time did
+not get slower for a code reason); a changed ledger names the counter that
+moved (the workload or its instrumentation changed); a schema bump is
+named as the comparability fence it is.
+
+--check is the gate: exit 3 when any ADJACENT same-schema pair's ledger
+regressed (a counter grew), naming the pair and the counter. Cross-schema
+pairs are fenced off exactly like tools/bench_diff.py fences them — a
+bump marks an intentional instrumentation/workload change, so the first
+post-bump round re-baselines the series. Exit 1 on an unreadable file.
+
+Exit codes: 0 clean; 1 unreadable artifact; 3 ledger regression.
+Standalone: stdlib-only, no package import (same contract as bench_diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+# flat payload key -> ledger counter name: the schema v3–v6 fallback for
+# rounds that predate the structured work_ledger block (kept in lockstep
+# with bench.py's _DISPATCH_FALLBACK / obs.ledger.BENCH_DISPATCH_KEYS)
+FLAT_LEDGER_KEYS = {
+    "device_dispatches": "device_dispatches",
+    "executable_compiles": "executable_compiles",
+    "donated_bytes": "donated_bytes",
+    "est_flops": "estimated_flops",
+}
+
+# wall ratio between adjacent rounds that earns a divergence annotation
+WALL_DIVERGENCE_RATIO = 1.5
+
+_JSON_LINE = re.compile(r"^\{.*\}$")
+_ROUND = re.compile(r"BENCH_r?0*(\d+)\.json$")
+
+
+def _payload_from_tail(tail: str) -> Optional[dict]:
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if _JSON_LINE.match(line):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+    return None
+
+
+def load_round(path: str) -> dict:
+    """One row per artifact: {round, path, rc, payload|None, note}. Unlike
+    bench_diff.load_payload this is LENIENT on payload-less wrappers — a
+    failed round is a fact of the series, not an input error. Unreadable
+    JSON still raises (exit 1): a corrupt artifact is repo damage."""
+    m = _ROUND.search(os.path.basename(path))
+    rnd = int(m.group(1)) if m else -1
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    rc = doc.get("rc")
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        payload = doc.get("parsed") or _payload_from_tail(doc.get("tail", ""))
+    else:  # raw bench.py line committed directly
+        payload = doc if "metric" in doc else None
+    note = ""
+    if not payload:
+        payload = None
+        tail = (doc.get("tail") or "").strip()
+        reason = tail.splitlines()[-1][:60] if tail else "no output"
+        note = f"failed round (rc={rc}): {reason}"
+    return {"round": rnd, "path": path, "rc": rc, "payload": payload,
+            "note": note}
+
+
+def ledger_of(payload: dict) -> Optional[dict]:
+    """The payload's deterministic ledger: the structured
+    ``work_ledger.counters`` block (schema v7+), else the flat v3–v6
+    dispatch keys mapped onto counter names, else None (pre-v3 rounds)."""
+    wl = payload.get("work_ledger")
+    if isinstance(wl, dict) and isinstance(wl.get("counters"), dict):
+        return dict(wl["counters"])
+    flat = {
+        name: payload[key]
+        for key, name in FLAT_LEDGER_KEYS.items()
+        if key in payload
+    }
+    return flat or None
+
+
+def trial_cv(payload: dict) -> Optional[float]:
+    wt = payload.get("wall_trials")
+    if not isinstance(wt, dict) or not wt.get("trials"):
+        return None
+    try:
+        return float(wt["cv"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def collect(root: str) -> List[dict]:
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    rows = []
+    for path in paths:
+        try:
+            rows.append(load_round(path))
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"perf_history: {path}: unreadable ({e})", file=sys.stderr)
+            raise SystemExit(1)
+    return rows
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rstrip("0").rstrip(".") or "0"
+    return str(v)
+
+
+def _ledger_delta_note(prev_led: dict, led: dict) -> str:
+    moved = []
+    for k in sorted(set(prev_led) | set(led)):
+        a, b = float(prev_led.get(k, 0)), float(led.get(k, 0))
+        if a != b:
+            moved.append(f"{k} {int(a)}->{int(b)}")
+    return ", ".join(moved[:3]) + (", ..." if len(moved) > 3 else "")
+
+
+def annotate(rows: List[dict]) -> None:
+    """Stamp each payload row's divergence note vs the previous payload row:
+    the ledger-vs-wall split that tells host noise from changed work."""
+    prev = None
+    for row in rows:
+        p = row["payload"]
+        if p is None:
+            continue
+        if prev is not None:
+            notes = []
+            s_prev, s_cur = prev.get("obs_schema", 0), p.get("obs_schema", 0)
+            if s_prev != s_cur:
+                notes.append(f"schema v{s_prev or '-'}->v{s_cur or '-'}")
+            w_prev, w_cur = prev.get("wall_s"), p.get("wall_s")
+            led_prev, led_cur = ledger_of(prev), ledger_of(p)
+            comparable = (
+                led_prev is not None and led_cur is not None
+                and set(led_prev) == set(led_cur)
+            )
+            if w_prev and w_cur:
+                ratio = w_cur / w_prev
+                big = ratio >= WALL_DIVERGENCE_RATIO or (
+                    ratio <= 1.0 / WALL_DIVERGENCE_RATIO
+                )
+                if comparable and led_prev == led_cur and big:
+                    notes.append(
+                        f"wall x{max(ratio, 1 / ratio):.1f} "
+                        f"{'slower' if ratio > 1 else 'faster'}, ledger "
+                        "identical => host noise"
+                    )
+                elif comparable and led_prev != led_cur:
+                    notes.append(
+                        "ledger changed: "
+                        + _ledger_delta_note(led_prev, led_cur)
+                    )
+                elif big and not comparable:
+                    notes.append(
+                        f"wall x{max(ratio, 1 / ratio):.1f} "
+                        f"{'slower' if ratio > 1 else 'faster'} "
+                        "(no comparable ledger on both sides: noise vs "
+                        "work undecidable — the gap the v7 work ledger "
+                        "closes)"
+                    )
+            elif comparable and led_prev != led_cur:
+                notes.append(
+                    "ledger changed: " + _ledger_delta_note(led_prev, led_cur)
+                )
+            if notes:
+                row["note"] = "; ".join(notes)
+        prev = p
+
+
+def trend_table(rows: List[dict]) -> str:
+    annotate(rows)
+    header = (
+        f"{'round':>5} {'schema':>6} {'boots/s':>9} {'wall_s':>8} "
+        f"{'cv':>6} {'disp':>6} {'comp':>6} {'gflops':>9} {'rss_mb':>8}  note"
+    )
+    lines = [header, "-" * len(header)]
+    for row in rows:
+        p = row["payload"]
+        if p is None:
+            lines.append(
+                f"{row['round']:>5} {'-':>6} {'-':>9} {'-':>8} {'-':>6} "
+                f"{'-':>6} {'-':>6} {'-':>9} {'-':>8}  {row['note']}"
+            )
+            continue
+        led = ledger_of(p) or {}
+        flops = led.get("estimated_flops")
+        schema = p.get("obs_schema") or None
+        lines.append(
+            f"{row['round']:>5} "
+            f"{_fmt(schema):>6} "
+            f"{_fmt(p.get('value')):>9} "
+            f"{_fmt(p.get('wall_s')):>8} "
+            f"{_fmt(trial_cv(p), 2):>6} "
+            f"{_fmt(led.get('device_dispatches')):>6} "
+            f"{_fmt(led.get('executable_compiles')):>6} "
+            f"{_fmt(flops / 1e9 if flops is not None else None, 2):>9} "
+            f"{_fmt(p.get('peak_rss_mb'), 1):>8}  "
+            f"{row['note']}"
+        )
+    return "\n".join(lines)
+
+
+def ledger_regressions(rows: List[dict]) -> List[str]:
+    """Counter growth between ADJACENT same-schema payload rounds — the
+    committed-series analogue of ``bench_diff --gate work``. Cross-schema
+    pairs are fenced (a bump re-baselines the series); rounds without a
+    ledger (pre-v3) never gate."""
+    out = []
+    prev_row = None
+    for row in rows:
+        if row["payload"] is None:
+            continue
+        if prev_row is not None:
+            a, b = prev_row["payload"], row["payload"]
+            if a.get("obs_schema", 0) == b.get("obs_schema", 0):
+                la, lb = ledger_of(a), ledger_of(b)
+                if la is not None and lb is not None:
+                    for k in sorted(set(la) | set(lb)):
+                        va, vb = float(la.get(k, 0)), float(lb.get(k, 0))
+                        if vb > va:
+                            out.append(
+                                f"r{prev_row['round']:02d} -> "
+                                f"r{row['round']:02d}: {k} grew "
+                                f"{int(va)} -> {int(vb)}"
+                            )
+        prev_row = row
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding BENCH_*.json (default: this repo)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 3 on a ledger regression between adjacent "
+                         "same-schema committed rounds")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    rows = collect(args.dir)
+    if not rows:
+        print(f"perf_history: no BENCH_*.json under {args.dir}",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        annotate(rows)
+        out = [
+            {
+                "round": r["round"], "rc": r["rc"], "note": r["note"],
+                "schema": (r["payload"] or {}).get("obs_schema"),
+                "value": (r["payload"] or {}).get("value"),
+                "wall_s": (r["payload"] or {}).get("wall_s"),
+                "cv": trial_cv(r["payload"]) if r["payload"] else None,
+                "ledger": ledger_of(r["payload"]) if r["payload"] else None,
+            }
+            for r in rows
+        ]
+        print(json.dumps(out, indent=2))
+    else:
+        print(trend_table(rows))
+    regressions = ledger_regressions(rows)
+    if args.check:
+        if regressions:
+            for r in regressions:
+                print(f"LEDGER REGRESSION {r}", file=sys.stderr)
+            return 3
+        print(f"perf_history: ok ({len(rows)} rounds, no ledger "
+              "regressions across same-schema pairs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

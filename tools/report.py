@@ -12,9 +12,11 @@ Usage:
 Produces: a per-phase table (top-level spans, seconds, % of wall), a
 flamegraph-style text rendering of the span tree, a "== memory ==" table
 (per-phase peak RSS/device watermarks when the run sampled resources —
-obs schema >= 4), error events, and the metrics snapshot (bucketed
-histograms render p50/p99 estimates). --trace additionally renders the
-resource series as Perfetto counter tracks under the span lanes.
+obs schema >= 4), a "== work ==" table (the deterministic per-phase work
+ledger — obs schema >= 7), error events, and the metrics snapshot
+(bucketed histograms render p50/p99 estimates). --trace additionally
+renders the resource series as Perfetto counter tracks under the span
+lanes.
 
 Deliberately standalone — parses the schema-versioned JSON directly, no
 package (or jax) import, so it runs anywhere a record file lands (including
@@ -31,7 +33,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -262,6 +264,56 @@ def dispatch(record: dict) -> str:
     return "\n".join(lines)
 
 
+def work(record: dict) -> str:
+    """Deterministic work-ledger table (obs schema >= 7): the
+    ``work_ledger`` block obs/ledger.py stamps into the RunRecord — total
+    counter deltas plus the per-top-level-phase attribution. These are the
+    noise-free numbers ``bench_diff --gate work`` gates exactly; rendering
+    them next to the wall tables is what lets a reader split "slower" into
+    "did more work" vs "same work on a busier host". Records written before
+    schema v7 render the placeholder line — absence is normal, never an
+    error (same contract as the serving/dispatch/memory tables)."""
+    wl = record.get("work_ledger") or {}
+    counters = wl.get("counters") or {}
+    if not counters:
+        return "(no work ledger; schema < 7 record)"
+    cols = (
+        ("disp", "device_dispatches"),
+        ("comp", "executable_compiles"),
+        ("gflops", "estimated_flops"),
+        ("acc_mb", "estimated_bytes_accessed"),
+        ("don_mb", "donated_bytes"),
+        ("boots", "boots_completed"),
+        ("fault", "fault_injected"),
+        ("retry", "retry_attempts"),
+        ("exh", "retries_exhausted"),
+        ("quar", "ckpt_quarantined"),
+    )
+
+    def fmt(vals: dict, key: str) -> str:
+        v = vals.get(key)
+        if v is None:
+            return "-"
+        if key == "estimated_flops":
+            return f"{v / 1e9:.2f}"
+        if key in ("estimated_bytes_accessed", "donated_bytes"):
+            return f"{v / 1e6:.1f}"
+        return f"{v:g}"
+
+    header = f"{'phase':<14}" + "".join(f"{label:>8}" for label, _ in cols)
+    lines = [header]
+    for phase, vals in (wl.get("phases") or {}).items():
+        lines.append(
+            f"{phase:<14}"
+            + "".join(f"{fmt(vals, key):>8}" for _, key in cols)
+        )
+    lines.append(
+        f"{'(total)':<14}"
+        + "".join(f"{fmt(counters, key):>8}" for _, key in cols)
+    )
+    return "\n".join(lines)
+
+
 def consensus(record: dict) -> str:
     """Consensus-regime provenance table (ISSUE 9): which accumulator regime
     assembled each consensus (the ``cocluster`` span's ``consensus_regime``
@@ -427,6 +479,7 @@ def render(record: dict) -> str:
         "", "== serving ==", serving(record),
         "", "== consensus ==", consensus(record),
         "", "== dispatch ==", dispatch(record),
+        "", "== work ==", work(record),
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),
         "", "== metrics ==", metrics_summary(record),

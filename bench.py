@@ -133,6 +133,7 @@ _DISPATCH_FALLBACK = {
     "executable_compiles": "executable_compiles",
     "donated_bytes": "donated_bytes",
     "est_flops": "estimated_flops",
+    "est_bytes": "estimated_bytes_accessed",
 }
 try:
     from consensusclustr_tpu.obs.ledger import (
@@ -392,6 +393,130 @@ _SERVING_SLO_ZERO = {
     "serving_p99_ms": 0.0,
     "serve_rejection_rate": 0.0,
 }
+
+# The warm-start rung's zero shape (ISSUE 13) — emitted verbatim on the
+# failure rung so BENCH_*.json lines stay key-comparable across rounds.
+_WARM_START_ZERO = {
+    "buckets": 0,
+    "cold_compiles": 0,
+    "warm_compiles": 0,
+    "cold_warmup_s": 0.0,
+    "warm_warmup_s": 0.0,
+    "warm_aot_hits": 0,
+    "aot_entries": 0,
+}
+
+# One cold-process serving warm-up, self-reported: load the bundle, warm the
+# service (no worker start), print the per-process executable_compiles /
+# AOT-hit counters as JSON. Runs as a CHILD process so each measurement sees
+# a genuinely cold jit cache — the only honest way to measure a
+# cross-process warm start.
+_WARM_START_CHILD = """
+import json, sys, time
+from consensusclustr_tpu.serve.artifact import ReferenceArtifact
+from consensusclustr_tpu.serve.service import AssignmentService
+from consensusclustr_tpu.obs import global_metrics
+
+art = ReferenceArtifact.load(sys.argv[1])
+t0 = time.perf_counter()
+svc = AssignmentService(art, max_batch=int(sys.argv[2]), warmup=True,
+                        start=False)
+warmup_s = time.perf_counter() - t0
+svc.close()
+reg = global_metrics()
+
+
+def _c(name):
+    c = reg.counters.get(name)
+    return int(c.value) if c is not None else 0
+
+
+print(json.dumps({
+    "warmup_s": round(warmup_s, 4),
+    "executable_compiles": _c("executable_compiles"),
+    "aot_hits": _c("aot_cache_hits"),
+    "aot_saves": _c("aot_cache_saves"),
+}))
+"""
+
+
+def _warm_start_rung() -> dict:
+    """Cross-process AOT warm start (ISSUE 13): two cold interpreter runs of
+    the SAME serving warm-up against one reference bundle and one AOT cache
+    dir. Run 1 (cold cache) traces + compiles every bucket and serializes the
+    executables; run 2 (warm cache) deserializes them. The rung reports both
+    processes' ``executable_compiles`` and warm-up walls — the warm process
+    must compile strictly less (tools/bench_diff.py gates
+    ``warm_start.warm_compiles``). Never raises: any failure returns the
+    zero shape with an error note."""
+    try:
+        import subprocess
+        import tempfile
+
+        from consensusclustr_tpu.serve.artifact import (
+            ReferenceArtifact,
+            level_tables,
+        )
+        from consensusclustr_tpu.serve.assign import (
+            embed_reference_counts,
+            resolve_buckets,
+        )
+
+        rng = np.random.default_rng(7)
+        n_ref = int(os.environ.get("BENCH_WARM_REF", 256))
+        g = int(os.environ.get("BENCH_WARM_GENES", 64))
+        max_batch = 16
+        d, n_classes = 6, 4
+
+        loadings = np.linalg.qr(rng.normal(size=(g, d)))[0].astype(np.float32)
+        mu = rng.gamma(1.0, 1.0, g).astype(np.float32)
+        sigma = np.ones(g, np.float32)
+        ref_counts = rng.poisson(2.0, size=(n_ref, g)).astype(np.float32)
+        libsize_mean = float(ref_counts.sum(axis=1).mean())
+        emb = embed_reference_counts(ref_counts, mu, sigma, loadings,
+                                     libsize_mean)
+        codes, tables = level_tables(
+            np.asarray([str(c + 1) for c in rng.integers(0, n_classes, n_ref)])
+        )
+        art = ReferenceArtifact(
+            embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+            libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+            stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+        )
+        with tempfile.TemporaryDirectory() as tmp:
+            art_path = os.path.join(tmp, "ref")
+            art.save(art_path)
+            aot_dir = os.path.join(tmp, "aot")
+            env = dict(os.environ, CCTPU_AOT_CACHE_DIR=aot_dir)
+            # the rung measures the AOT mechanism itself: no exporter ports,
+            # no kill-switch leaking in from the surrounding round
+            env.pop("CCTPU_SERVE_METRICS_PORT", None)
+            env.pop("CCTPU_NO_AOT_CACHE", None)
+
+            def _child() -> dict:
+                proc = subprocess.run(
+                    [sys.executable, "-c", _WARM_START_CHILD, art_path,
+                     str(max_batch)],
+                    env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True, timeout=600,
+                )
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+
+            cold = _child()
+            entries = len(os.listdir(aot_dir)) if os.path.isdir(aot_dir) else 0
+            warm = _child()
+        return {
+            "buckets": len(resolve_buckets(None, max_batch)),
+            "cold_compiles": int(cold["executable_compiles"]),
+            "warm_compiles": int(warm["executable_compiles"]),
+            "cold_warmup_s": float(cold["warmup_s"]),
+            "warm_warmup_s": float(warm["warmup_s"]),
+            "warm_aot_hits": int(warm["aot_hits"]),
+            "aot_entries": entries,
+        }
+    except Exception as e:
+        return dict(_WARM_START_ZERO, error=str(e)[:200])
+
 
 # The sparse-consensus rung's zero shape (ISSUE 9) — emitted verbatim on the
 # failure rung so BENCH_*.json lines stay key-comparable across rounds.
@@ -790,6 +915,7 @@ def _run_pbmc3k() -> dict:
         "serving": _serving_rung(),
         **_serving_slo_rung(),
         "sparse_consensus": _sparse_consensus_rung(),
+        "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -860,6 +986,7 @@ def _run_granular() -> dict:
         "serving": _serving_rung(),
         **_serving_slo_rung(),
         "sparse_consensus": _sparse_consensus_rung(),
+        "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -910,6 +1037,15 @@ def _run() -> dict:
     )
     key = root_key(123)
     pca_dev = jnp.asarray(pca)
+
+    # Flat dispatch keys (schema v3/v4) bracket the HEADLINE workload only
+    # (warmup + trials + the parity probe), not the auxiliary sub-rungs:
+    # ISSUE 13 routes the serving path through counting_jit, so a
+    # process-wide window would conflate serving-rung instrumentation with
+    # the consensus workload these keys exist to compare round over round.
+    # main() only fills keys a config didn't set itself (failure rung and
+    # the non-default configs keep the historical process-wide window).
+    flat0 = _dispatch_counters()
 
     # Mirror the production dense dispatch (consensus/pipeline.py): the
     # einsum regime streams counts through the donated accumulator during the
@@ -1006,10 +1142,14 @@ def _run() -> dict:
         "phases": {k: round(v, 3) for k, v in tracer.phase_seconds().items()},
         "pipeline_depth": _pipeline_depth(),
         "overlap_ratio": _overlap_ratio(tracer.roots),
+        # evaluated HERE (dict literals evaluate in source order): the flat
+        # window closes before the sub-rungs below dispatch anything
+        **_dispatch_delta(flat0, _dispatch_counters()),
         **_resilience_counters(tracer),
         "serving": _serving_rung(),
         **_serving_slo_rung(),
         "sparse_consensus": _sparse_consensus_rung(),
+        "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
     }
 
@@ -1158,7 +1298,11 @@ def main() -> None:
         payload["probe_s"] = probe_s
         payload["env_health"] = envh.block(probe_s)
         payload.setdefault("work_ledger", _work_ledger_zero())
-        payload.update(_dispatch_delta(dispatch0, _dispatch_counters()))
+        # configs that scoped their own flat window (the default rung's
+        # headline-workload bracket) keep it; everything else gets the
+        # historical process-wide delta
+        for _k, _v in _dispatch_delta(dispatch0, _dispatch_counters()).items():
+            payload.setdefault(_k, _v)
         payload.update(_resource_rung(sampler))
         del ballast
         _emit(payload)
@@ -1219,6 +1363,7 @@ def main() -> None:
             **{k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()},
             "sparse_consensus": dict(_SPARSE_CONSENSUS_ZERO),
+            "warm_start": dict(_WARM_START_ZERO),
             "probe_s": probe_s,
             # noise-proofing blocks keep their shape on failure too: real
             # env_health (the contention evidence for the failed round),

@@ -120,13 +120,14 @@ def community_detect(
 
 def _grid_one_k(
     key, x, idx_max, res_list, ki, kv, min_size, max_clusters, n_iters,
-    update_frac, cluster_fun,
+    update_frac, cluster_fun, snn_impl="jax",
 ):
     """One k of the candidate grid: masked SNN build + Leiden/Louvain vmapped
     over the resolution axis. ``ki``/``kv`` may be traced (the fused grid
-    vmaps this over the k axis) or concrete (the looped parity oracle)."""
+    vmaps this over the k axis) or concrete (the looped parity oracle).
+    ``snn_impl`` is static — see ``resolve_snn_impl``."""
     r = res_list.shape[0]
-    graph = snn_graph(idx_max, k=kv)
+    graph = snn_graph(idx_max, k=kv, snn_impl=snn_impl)
     keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r))
 
     def one_res(kk, res):
@@ -144,7 +145,7 @@ def _grid_one_k(
     jax.jit,
     static_argnames=(
         "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
-        "compute_dtype",
+        "compute_dtype", "snn_impl",
     ),
 )
 def cluster_grid(
@@ -158,6 +159,7 @@ def cluster_grid(
     update_frac: float = 0.5,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
+    snn_impl: str = "jax",
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set, as ONE fused
     program over the full [K, R] grid.
@@ -187,7 +189,7 @@ def cluster_grid(
     labels, nc, scores = jax.vmap(
         lambda ki, kv: _grid_one_k(
             key, x, idx_max, res_list, ki, kv, min_size, max_clusters,
-            n_iters, update_frac, cluster_fun,
+            n_iters, update_frac, cluster_fun, snn_impl=snn_impl,
         )
     )(jnp.arange(n_k, dtype=jnp.int32), jnp.asarray(k_list, jnp.int32))
 
@@ -204,7 +206,7 @@ def cluster_grid(
     jax.jit,
     static_argnames=(
         "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
-        "compute_dtype",
+        "compute_dtype", "snn_impl",
     ),
 )
 def cluster_grid_looped(
@@ -218,6 +220,7 @@ def cluster_grid_looped(
     update_frac: float = 0.5,
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
+    snn_impl: str = "jax",
 ) -> GridResult:
     """Parity oracle for the fused ``cluster_grid``: the historical per-k
     Python loop (one SNN build + one vmapped res sweep per k, concatenated),
@@ -233,6 +236,7 @@ def cluster_grid_looped(
         labels_k, nc_k, scores_k = _grid_one_k(
             key, x, idx_max, res_list, ki, jnp.int32(k), min_size,
             max_clusters, n_iters, update_frac, cluster_fun,
+            snn_impl=snn_impl,
         )
         all_labels.append(labels_k)
         all_nc.append(nc_k)
@@ -266,6 +270,66 @@ def resolve_grid_impl(value: Optional[str] = None) -> str:
 def grid_fn(impl: str):
     """The cluster-grid entry for a resolved impl name."""
     return cluster_grid_looped if impl == "looped" else cluster_grid
+
+
+SNN_IMPLS = ("jax", "pallas")
+
+# one-shot result of the pallas SNN smoke probe ({} until first resolve that
+# wants pallas; then {"ok": bool}) — a runtime lowering/execution failure
+# degrades every subsequent resolve to "jax", warned once
+_SNN_PROBE: dict = {}
+
+
+def _pallas_snn_ok() -> bool:
+    """Execute the fused SNN kernel on a toy input (block_until_ready, so
+    lowering AND runtime failures both surface here) — the same degrade
+    contract as the cocluster kernel: warn once, fall back to the jax
+    build, never crash the pipeline."""
+    if "ok" not in _SNN_PROBE:
+        try:
+            from consensusclustr_tpu.ops.pallas_snn import (
+                pallas_rank_halfweights,
+            )
+
+            out = pallas_rank_halfweights(
+                jnp.zeros((8, 2), jnp.int32)
+            )
+            jax.block_until_ready(out)
+            _SNN_PROBE["ok"] = True
+        except Exception as e:  # pragma: no cover - backend-specific
+            import warnings
+
+            warnings.warn(
+                "pallas SNN kernel failed its smoke probe — falling back "
+                f"to the jax rank build ({type(e).__name__}: {e})",
+                RuntimeWarning,
+            )
+            _SNN_PROBE["ok"] = False
+    return _SNN_PROBE["ok"]
+
+
+def resolve_snn_impl(value: Optional[str] = None) -> str:
+    """Which SNN rank-scan backend ``snn_graph`` runs: "jax" (the lax.scan
+    build) or "pallas" (ops/pallas_snn.py — bit-identical, pinned by
+    tools/parity_audit.py's ``snn_jax:snn_pallas`` pair). Explicit ``value``
+    beats the ``CCTPU_SNN_IMPL`` env var beats the backend default (pallas
+    on TPU, jax elsewhere — interpret-mode pallas is a correctness path, not
+    a perf path, so CPU keeps the scan build and its ledger baseline).
+
+    Degrade contract: ``CCTPU_NO_PALLAS`` (the cocluster kill switch) forces
+    "jax" over any request, and a pallas resolution only sticks if the
+    kernel survives a one-shot executed smoke probe — otherwise warn and
+    fall back, so a Mosaic regression costs a warning, not the run."""
+    v = (value or os.environ.get("CCTPU_SNN_IMPL", "") or "").strip().lower()
+    if not v:
+        v = "pallas" if jax.default_backend() == "tpu" else "jax"
+    if v not in SNN_IMPLS:
+        raise ValueError(f"snn impl must be one of {SNN_IMPLS}; got {v!r}")
+    if v == "pallas" and os.environ.get("CCTPU_NO_PALLAS"):
+        return "jax"
+    if v == "pallas" and not _pallas_snn_ok():
+        return "jax"
+    return v
 
 
 @functools.partial(jax.jit, static_argnames=("n_cells",))

@@ -24,6 +24,22 @@ whole k sweep of ``cluster_grid`` vmaps into one program instead of unrolling
 one SNN build + Leiden sweep per k. Weights, degrees and two_m of the valid
 slots are bit-identical to the sliced build (the rank weights are dyadic
 rationals ≤ k, so their sums are exact in f32 under any reduction order).
+
+Exact low-precision lanes (ISSUE 13 tentpole): the rank weight k - r/2 is a
+dyadic rational, so its HALF-weight 2*w = 2k - r is an exact small integer
+(≤ 2*k_max). The build/symmetrise/degree hot path therefore carries int16
+half-weights — halving the scan-carry and slot-tensor bandwidth — and
+converts to f32 only at the Leiden boundary (the ``SNNGraph.w`` field).
+Integer-exact, not approximate: ``hw.astype(f32) * 0.5`` reproduces the old
+f32 arithmetic bit for bit (both compute the mathematically exact value; per
+row the degree is < 2^24 half-units, so the int32 row-sum * 0.5 equals the
+f32 sum of exact halves). ``two_m`` stays the f32 sum over ``deg`` so the
+n-length reduction is the same one the f32 build ran.
+
+``snn_impl`` selects the rank-scan backend: "jax" (the lax.scan build) or
+"pallas" (ops/pallas_snn.py — the compare-min fused into a VMEM-tiled kernel,
+bit-identical by construction; see resolve_snn_impl in cluster/engine.py for
+the default and the runtime degrade contract).
 """
 
 from __future__ import annotations
@@ -40,17 +56,31 @@ class SNNGraph(NamedTuple):
     w: jax.Array      # [n, 2k] float32 edge weights (0 where invalid)
     deg: jax.Array    # [n] weighted degree
     two_m: jax.Array  # scalar, total weight * 2 == deg.sum()
+    rev_dropped: jax.Array  # scalar int32: reverse-edge slot collisions
+    #                         (duplicate in-edges silently dropped — the
+    #                         "keep one arbitrarily" approximation count)
+
+
+def _rank_sentinel(k: int) -> int:
+    """An int16 rank-sum sentinel: any r >= 2k clamps the half-weight to 0,
+    so 2k + 4 is unreachable-but-cheap; it must survive ``sentinel + q``
+    (q <= k + 1) without int16 overflow, which holds to k ~ 10000 — far past
+    the [n, k+1, k] transient's own feasibility."""
+    return 2 * k + 4
 
 
 @functools.partial(jax.jit, static_argnames=())
-def _rank_weights(idx: jax.Array) -> jax.Array:
-    """w[i, a] = k - r/2 for edge i -> idx[i, a] under the rank rule.
+def _rank_halfweights(idx: jax.Array) -> jax.Array:
+    """hw[i, a] = max(2k - r, 0) as int16 for edge i -> idx[i, a] under the
+    rank rule (the exact half-weight lane: w = hw / 2).
 
     r is min_{p,q}(p + q) over matching members, computed as a scan over
     the q axis (rank position in the TARGET's list) with a [n, k+1, k]
     compare transient per step — the one-shot 4-D eq tensor
     ([n, k, (k+1)^2] elements) is a TPU bandwidth wall at n >= 10k, and the
-    per-step compare+min fuses on the VPU.
+    per-step compare+min fuses on the VPU. The carry and the transient are
+    int16: rank sums are small integers, so the low-precision lane is exact
+    while moving half the bytes of the old f32 scan.
 
     The scan-over-q orientation exists so the only gather is the composed
     cheap form `lists[:, q][idx]` — a 1-D dynamic slice then a gather whose
@@ -60,57 +90,74 @@ def _rank_weights(idx: jax.Array) -> jax.Array:
     restructuring and docs/perf.md).
     """
     n, k = idx.shape
+    sent = jnp.int16(_rank_sentinel(k))
     self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
     lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k+1], rank = position
-    pranks = jnp.arange(k + 1, dtype=jnp.float32)
+    pranks = jnp.arange(k + 1, dtype=jnp.int16)
 
     def body(r, q):
         other_q = lists[:, q][idx]                            # [n, k], composed gather
         mask = lists[:, :, None] == other_q[:, None, :]       # [n, k+1, k]
-        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], jnp.inf), axis=1)
-        return jnp.minimum(r, best_p + q.astype(jnp.float32)), None
+        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], sent), axis=1)
+        return jnp.minimum(r, best_p + q.astype(jnp.int16)), None
 
     # `+ idx[0,0]*0` inherits idx's varying-manual-axes type so the carry
     # typechecks inside shard_map (scan-vma rule; see leiden.py)
-    r0 = jnp.full((n, k), jnp.inf) + (idx[0, 0] * 0).astype(jnp.float32)
+    r0 = jnp.full((n, k), sent, jnp.int16) + (idx[0, 0] * 0).astype(jnp.int16)
     r, _ = jax.lax.scan(body, r0, jnp.arange(k + 1))
-    return jnp.maximum(k - r / 2.0, 0.0)
+    return jnp.maximum(jnp.int16(2 * k) - r, 0).astype(jnp.int16)
 
 
 @jax.jit
-def _rank_weights_masked(idx: jax.Array, kv: jax.Array) -> jax.Array:
-    """_rank_weights over the first ``kv`` columns of a padded [n, k_max]
-    index tensor; columns >= kv weigh 0. Bit-identical in the valid columns
-    to ``_rank_weights(idx[:, :kv])``: the masked entries enter the min as
-    +inf and every step with q > kv leaves the carry untouched, so the same
-    (p, q) pairs survive."""
+def _rank_halfweights_masked(idx: jax.Array, kv: jax.Array) -> jax.Array:
+    """_rank_halfweights over the first ``kv`` columns of a padded
+    [n, k_max] index tensor; columns >= kv carry 0. Bit-identical in the
+    valid columns to ``_rank_halfweights(idx[:, :kv])``: the masked entries
+    enter the min as the sentinel and every step with q > kv leaves the
+    carry untouched, so the same (p, q) pairs survive."""
     n, k_max = idx.shape
+    sent = jnp.int16(_rank_sentinel(k_max))
     kv = jnp.asarray(kv, jnp.int32)
     colv = jnp.arange(k_max, dtype=jnp.int32) < kv            # [k_max]
     self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
     lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k_max+1]
-    pranks = jnp.arange(k_max + 1, dtype=jnp.float32)
+    pranks = jnp.arange(k_max + 1, dtype=jnp.int16)
     # list position p is valid iff p == 0 (self) or column p-1 < kv
     pvalid = jnp.concatenate([jnp.array([True]), colv])       # [k_max+1]
 
     def body(r, q):
         other_q = lists[:, q][idx]                            # [n, k_max]
         mask = (lists[:, :, None] == other_q[:, None, :]) & pvalid[None, :, None]
-        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], jnp.inf), axis=1)
-        r_new = jnp.minimum(r, best_p + q.astype(jnp.float32))
+        best_p = jnp.min(jnp.where(mask, pranks[None, :, None], sent), axis=1)
+        r_new = jnp.minimum(r, best_p + q.astype(jnp.int16))
         return jnp.where(pvalid[q], r_new, r), None
 
     # `+ idx[0,0]*0` inherits idx's varying-manual-axes type (scan-vma rule)
-    r0 = jnp.full((n, k_max), jnp.inf) + (idx[0, 0] * 0).astype(jnp.float32)
+    r0 = jnp.full((n, k_max), sent, jnp.int16) + (idx[0, 0] * 0).astype(jnp.int16)
     r, _ = jax.lax.scan(body, r0, jnp.arange(k_max + 1))
-    w = jnp.maximum(kv.astype(jnp.float32) - r / 2.0, 0.0)
-    return jnp.where(colv[None, :], w, 0.0)
+    hw = jnp.maximum((2 * kv).astype(jnp.int16) - r, 0).astype(jnp.int16)
+    return jnp.where(colv[None, :], hw, jnp.int16(0))
 
 
-def _assemble_graph(idx: jax.Array, w_out: jax.Array, colv) -> SNNGraph:
-    """Symmetrise [n, k] out-edges into the [n, 2k] slot graph. ``colv`` is
-    None for the plain build, or a [k] bool mask of valid columns for the
-    mask-based build (invalid slots: nbr = self id, w = 0)."""
+@functools.partial(jax.jit, static_argnames=())
+def _rank_weights(idx: jax.Array) -> jax.Array:
+    """f32 rank weights — the historical entry, now a thin exact conversion
+    of the int16 half-weight lane (hw / 2 is the dyadic rational w)."""
+    return _rank_halfweights(idx).astype(jnp.float32) * 0.5
+
+
+@jax.jit
+def _rank_weights_masked(idx: jax.Array, kv: jax.Array) -> jax.Array:
+    """f32 masked rank weights over the int16 half-weight lane."""
+    return _rank_halfweights_masked(idx, kv).astype(jnp.float32) * 0.5
+
+
+def _assemble_graph(idx: jax.Array, hw_out: jax.Array, colv) -> SNNGraph:
+    """Symmetrise [n, k] int16 out-edge half-weights into the [n, 2k] slot
+    graph. ``colv`` is None for the plain build, or a [k] bool mask of valid
+    columns for the mask-based build (invalid slots: nbr = self id, w = 0).
+    The symmetrise/degree path stays in the int16/int32 lane; f32 appears
+    only in the returned ``w``/``deg``/``two_m`` (the Leiden boundary)."""
     n, k = idx.shape
     node_ids = jnp.arange(n, dtype=idx.dtype)
 
@@ -128,33 +175,54 @@ def _assemble_graph(idx: jax.Array, w_out: jax.Array, colv) -> SNNGraph:
     # Reverse edges: for non-mutual (i -> j), give j an in-edge slot (j -> i).
     # Slot (j, a) receives the source whose a-th neighbour is j; collisions
     # (several sources sharing the a-th-neighbour j) keep one arbitrarily —
-    # the dropped duplicates are rare and only shave edge weight, never add.
+    # the dropped duplicates only shave edge weight, never add, and their
+    # count surfaces as ``rev_dropped`` (the snn_rev_edges_dropped counter)
+    # so the approximation is observable instead of silent.
     live = ~mutual if colv is None else (~mutual & colv[None, :])
     src = jnp.where(live, node_ids[:, None], -1)
 
-    def rev_slot(_, slot):
-        col, src_col, w_col = slot
+    def rev_slot(dropped, slot):
+        col, src_col, hw_col = slot
         rn = jnp.full((n,), -1, jnp.int32).at[col].max(src_col)   # 1-D scatter
         got = rn >= 0
-        rw = jnp.where(got, w_col[jnp.maximum(rn, 0)], 0.0)       # 1-D gather
-        return _, (jnp.where(got, rn, node_ids), rw)
+        rw = jnp.where(got, hw_col[jnp.maximum(rn, 0)], jnp.int16(0))  # 1-D gather
+        # dtype= pins the reductions: under jax_enable_x64 (the parity
+        # auditor's f64 presets) a plain sum promotes to int64 and breaks
+        # the scan's carry-type contract
+        lost = (
+            jnp.sum(src_col >= 0, dtype=jnp.int32)
+            - jnp.sum(got, dtype=jnp.int32)
+        )
+        return dropped + lost, (jnp.where(got, rn, node_ids), rw)
 
-    _, (rev_nbr_t, rev_w_t) = jax.lax.scan(
-        rev_slot, None,
-        (jnp.moveaxis(idx, 1, 0), jnp.moveaxis(src, 1, 0), jnp.moveaxis(w_out, 1, 0)),
+    # `+ idx[0,0]*0`: scan-vma rule for the collision-count carry
+    drop0 = jnp.int32(0) + (idx[0, 0] * 0).astype(jnp.int32)
+    rev_dropped, (rev_nbr_t, rev_hw_t) = jax.lax.scan(
+        rev_slot, drop0,
+        (jnp.moveaxis(idx, 1, 0), jnp.moveaxis(src, 1, 0), jnp.moveaxis(hw_out, 1, 0)),
     )
     rev_nbr = jnp.moveaxis(rev_nbr_t, 0, 1)                   # [n, k]
-    rev_w = jnp.moveaxis(rev_w_t, 0, 1)
+    rev_hw = jnp.moveaxis(rev_hw_t, 0, 1)
 
     nbr_out = idx if colv is None else jnp.where(colv[None, :], idx, node_ids[:, None])
     nbr = jnp.concatenate([nbr_out, rev_nbr], axis=1)
-    w = jnp.concatenate([w_out, rev_w], axis=1)
-    deg = jnp.sum(w, axis=1)
-    return SNNGraph(nbr=nbr, w=w, deg=deg, two_m=jnp.sum(deg))
+    hw = jnp.concatenate([hw_out, rev_hw], axis=1)            # [n, 2k] int16
+    # exact f32 boundary: per-row degree < 2^24 half-units, so the int32
+    # row-sum * 0.5 IS the f32 sum of the exact halves, bit for bit; two_m
+    # stays the f32 reduction over deg (identical values, identical order)
+    deg = jnp.sum(hw.astype(jnp.int32), axis=1).astype(jnp.float32) * 0.5
+    w = hw.astype(jnp.float32) * 0.5
+    return SNNGraph(
+        nbr=nbr, w=w, deg=deg, two_m=jnp.sum(deg), rev_dropped=rev_dropped
+    )
 
 
-@jax.jit
-def snn_graph(idx: jax.Array, k: Optional[jax.Array] = None) -> SNNGraph:
+@functools.partial(jax.jit, static_argnames=("snn_impl",))
+def snn_graph(
+    idx: jax.Array,
+    k: Optional[jax.Array] = None,
+    snn_impl: str = "jax",
+) -> SNNGraph:
     """Build the symmetric rank-weighted SNN graph from kNN indices [n, k].
 
     With ``k=None`` (the default) every column is an edge — the historical
@@ -164,14 +232,30 @@ def snn_graph(idx: jax.Array, k: Optional[jax.Array] = None) -> SNNGraph:
     (nbr = self, w = 0), so one program covers every k of a k sweep — the
     fused ``cluster_grid`` vmaps this over its k axis.
 
+    ``snn_impl`` (static): "jax" runs the lax.scan rank build; "pallas" runs
+    the fused VMEM compare-min kernel (ops/pallas_snn.py) — bit-identical
+    output, resolved and degraded at the call-site level by
+    cluster/engine.resolve_snn_impl.
+
     Per-slot work is expressed as scans of 1-D-indexed gathers/scatters:
     2-D gathers whose index arrays are themselves computed lower ~30x slower
     on TPU than their 1-D or constant-index forms (see cluster/leiden.py's
     identical restructuring).
     """
     idx = jnp.asarray(idx, jnp.int32)
+    if snn_impl == "pallas":
+        from consensusclustr_tpu.ops.pallas_snn import (
+            pallas_rank_halfweights,
+            pallas_rank_halfweights_masked,
+        )
+
+        plain, masked = pallas_rank_halfweights, pallas_rank_halfweights_masked
+    elif snn_impl == "jax":
+        plain, masked = _rank_halfweights, _rank_halfweights_masked
+    else:
+        raise ValueError(f"unknown snn_impl {snn_impl!r} (want 'jax'|'pallas')")
     if k is None:
-        return _assemble_graph(idx, _rank_weights(idx), None)
+        return _assemble_graph(idx, plain(idx), None)
     kv = jnp.asarray(k, jnp.int32)
     colv = jnp.arange(idx.shape[1], dtype=jnp.int32) < kv
-    return _assemble_graph(idx, _rank_weights_masked(idx, kv), colv)
+    return _assemble_graph(idx, masked(idx, kv), colv)

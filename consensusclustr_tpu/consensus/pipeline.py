@@ -35,6 +35,7 @@ from consensusclustr_tpu.cluster.engine import (
     community_detect,
     grid_fn,
     resolve_grid_impl,
+    resolve_snn_impl,
     ties_last_argmax as _ties_last_argmax,
 )
 from consensusclustr_tpu.cluster.knn import knn_candidates, knn_from_distance
@@ -103,6 +104,8 @@ REGIME_ATTR = "consensus_regime"        # which regime assembled the consensus
 CANDIDATE_M_ATTR = "candidate_m"        # sparse regime's per-cell candidate count
 PAIRS_ATTR = "accumulated_pairs"        # pairs the accumulator tracked
 PAIRS_RATIO_ATTR = "pairs_ratio"        # accumulated pairs / n^2
+SNN_IMPL_ATTR = "snn_impl"              # which rank-scan backend built the SNN
+SNN_REV_DROPPED_ATTR = "snn_rev_edges_dropped"  # reverse-slot collisions dropped
 
 
 def dense_consensus_limit() -> int:
@@ -189,7 +192,7 @@ class ConsensusResult(NamedTuple):
 @counting_jit(
     static_argnames=(
         "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
-        "cluster_fun", "compute_dtype", "grid_impl",
+        "cluster_fun", "compute_dtype", "grid_impl", "snn_impl",
     ),
 )
 def _boot_batch(
@@ -207,6 +210,7 @@ def _boot_batch(
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
     grid_impl: str = "fused",
+    snn_impl: str = "jax",
 ):
     """One jitted chunk of bootstraps: gather -> grid -> select -> align.
 
@@ -214,14 +218,16 @@ def _boot_batch(
     the per-k looped parity oracle (cluster/engine.py) — bit-identical
     outputs by contract, so flipping it (CCTPU_GRID_IMPL, exercised by
     tools/parity_audit.py ``--pair fused:looped``) must not move a single
-    numeric checkpoint."""
+    numeric checkpoint. ``snn_impl`` routes the SNN rank scan the same way
+    (jax lax.scan vs the fused pallas kernel, ``--pair snn_jax:snn_pallas``
+    — also bit-identical by contract)."""
 
     def one(key_b, idx_b):
         x = pca[idx_b]
         grid = grid_fn(grid_impl)(
             key_b, x, res_list, k_list, min_size,
             max_clusters=max_clusters, n_iters=n_iters, cluster_fun=cluster_fun,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, snn_impl=snn_impl,
         )
         if robust:
             best = _ties_last_argmax(grid.scores)
@@ -303,6 +309,7 @@ def run_bootstraps(
     k_list = tuple(int(k) for k in cfg.k_num)
     robust = cfg.mode == "robust"
     grid_impl = resolve_grid_impl()
+    snn_impl = resolve_snn_impl()
     chunk = _auto_boot_chunk(
         n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list),
         n_k=len(k_list),
@@ -474,7 +481,7 @@ def run_bootstraps(
                         len(cfg.res_range), cfg.max_clusters,
                         DEFAULT_COMMUNITY_ITERS,
                         robust, n, cfg.cluster_fun, cfg.compute_dtype,
-                        grid_impl,
+                        grid_impl, snn_impl,
                     ),
                     meta=(s, e),
                 )
@@ -501,7 +508,11 @@ def run_bootstraps(
     return labels, scores
 
 
-@counting_jit(static_argnames=("k_list", "max_clusters", "n_iters", "cluster_fun"))
+@counting_jit(
+    static_argnames=(
+        "k_list", "max_clusters", "n_iters", "cluster_fun", "snn_impl",
+    )
+)
 def _consensus_grid_from_knn(
     key: jax.Array,
     knn_idx: jax.Array,  # [n, max(k_list)] kNN of the consensus distance
@@ -511,17 +522,24 @@ def _consensus_grid_from_knn(
     max_clusters: int,
     n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
+    snn_impl: str = "jax",
 ):
     """Consensus re-clustering (reference :423-441) from a precomputed kNN
     graph: SNN + Leiden per (k, resolution); rank by PCA silhouette with the
     all-singletons -> -1 floor (:445-453). Smaller-k graphs are prefixes of
     the max-k one (top_k order is deterministic), so one kNN pass serves the
     whole k sweep — and the dense and blockwise paths share this function,
-    which makes them select identical candidates."""
+    which makes them select identical candidates.
+
+    Also returns the summed reverse-edge collision count over the k sweep
+    (SNNGraph.rev_dropped) so the host can surface the
+    snn_rev_edges_dropped counter/span attr without re-running the build."""
     r = res_list.shape[0]
     all_labels, all_scores = [], []
+    rev_dropped = jnp.int32(0)
     for ki, k in enumerate(k_list):
-        graph = snn_graph(knn_idx[:, :k])
+        graph = snn_graph(knn_idx[:, :k], snn_impl=snn_impl)
+        rev_dropped = rev_dropped + graph.rev_dropped
         keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r))
 
         def one_res(kk, res):
@@ -539,7 +557,7 @@ def _consensus_grid_from_knn(
     # ties.method="last" here (:453), under which the max rank lands on the
     # first occurrence — the opposite of the boot path's "first"/last pairing.
     best = jnp.argmax(scores)
-    return labels[best], scores
+    return labels[best], scores, rev_dropped
 
 
 def _consensus_grid(
@@ -551,11 +569,13 @@ def _consensus_grid(
     max_clusters: int,
     n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
+    snn_impl: str = "jax",
 ):
     """Dense-matrix entry: one kNN pass at max k, then the shared grid."""
     idx, _ = knn_from_distance(dist, max(k_list))
     return _consensus_grid_from_knn(
-        key, idx, pca, res_list, k_list, max_clusters, n_iters, cluster_fun
+        key, idx, pca, res_list, k_list, max_clusters, n_iters, cluster_fun,
+        snn_impl=snn_impl,
     )
 
 
@@ -817,6 +837,7 @@ def consensus_cluster(
     # top-m PC-space neighbours and streams [n, m] donated carries the same
     # way — O(n·m) end to end; its consensus distance is born in kNN-graph
     # form, so the grid below consumes it directly.
+    snn_impl = resolve_snn_impl()
     accum = None
     cand_idx = None
     if dense and cfg.nboots > 1 and not _pallas_wanted(use_pallas, cfg.max_clusters):
@@ -861,12 +882,16 @@ def consensus_cluster(
                     )
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, dist)
                 sp.value = dist
-            with maybe_span(log, "consensus_grid") as sp:
-                cons_labels, cons_scores = _consensus_grid(
+            with maybe_span(
+                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+            ) as sp:
+                cons_labels, cons_scores, rev_dropped = _consensus_grid(
                     key, dist, pca, res_list, k_list, cfg.max_clusters,
-                    cluster_fun=cfg.cluster_fun,
+                    cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
+                sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})
+                metrics_of(log).counter("snn_rev_edges_dropped").inc(int(rev_dropped))
             dist_np = np.asarray(dist)
         elif regime == "sparse_knn":
             with maybe_span(
@@ -890,12 +915,16 @@ def consensus_cluster(
                 knn_idx, _ = accum.consensus_knn(max(k_list))
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
                 sp.value = knn_idx
-            with maybe_span(log, "consensus_grid") as sp:
-                cons_labels, cons_scores = _consensus_grid_from_knn(
+            with maybe_span(
+                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+            ) as sp:
+                cons_labels, cons_scores, rev_dropped = _consensus_grid_from_knn(
                     key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
-                    cluster_fun=cfg.cluster_fun,
+                    cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
+                sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})
+                metrics_of(log).counter("snn_rev_edges_dropped").inc(int(rev_dropped))
             agree, union = accum.carries()
             sparse_state = SparseConsensus(
                 cand_idx=np.asarray(accum.candidate_idx),
@@ -920,12 +949,16 @@ def consensus_cluster(
                 # consensus kNN graph is the comparable downstream artifact
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
                 sp.value = knn_idx
-            with maybe_span(log, "consensus_grid") as sp:
-                cons_labels, cons_scores = _consensus_grid_from_knn(
+            with maybe_span(
+                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+            ) as sp:
+                cons_labels, cons_scores, rev_dropped = _consensus_grid_from_knn(
                     key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
-                    cluster_fun=cfg.cluster_fun,
+                    cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
+                sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})
+                metrics_of(log).counter("snn_rev_edges_dropped").inc(int(rev_dropped))
             dist_np = None
     labels = np.asarray(cons_labels)
     if log:

@@ -82,6 +82,7 @@ BENCH_DISPATCH_KEYS = {
     "executable_compiles": COMPILES_WORK,
     "donated_bytes": DONATED_WORK,
     "est_flops": FLOPS_WORK,
+    "est_bytes": BYTES_WORK,
 }
 
 

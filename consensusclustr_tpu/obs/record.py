@@ -106,6 +106,18 @@ class RunRecord:
         if include_global_metrics:
             from consensusclustr_tpu.obs.metrics import global_metrics
 
+            # Re-sample the compile_cache_entries gauge so the record shows
+            # the POST-run cache state, not the stale enable-time count
+            # (ISSUE 13 satellite). Lazy + guarded: this module stays
+            # importable without jax, and observability never fails a run.
+            try:
+                from consensusclustr_tpu.utils.compile_cache import (
+                    refresh_cache_entries_gauge,
+                )
+
+                refresh_cache_entries_gauge()
+            except Exception:
+                pass
             reg.merge(global_metrics())
         reg.merge(tracer.metrics)
         sampler = getattr(tracer, "resource_sampler", None)

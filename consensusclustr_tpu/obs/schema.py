@@ -80,6 +80,12 @@ from __future__ import annotations
 # tools/perf_history.py walks the committed BENCH_*.json series with
 # ledger-vs-wall divergence annotations. See docs/quirks.md
 # "Observability schema v6 → v7".
+# ISSUE 13 (Pallas SNN kernel + int16 lanes + AOT warm start) is additive —
+# no bump: the SNN_IMPLS registry below, the snn_impl/snn_rev_edges_dropped
+# consensus-span attrs, the ``snn_rev_edges_dropped`` counter, the AOT
+# executable-cache counters and the ``aot_warm_start`` event are new names
+# with no change to any existing one; the RunRecord layout is untouched and
+# the bench ``warm_start`` rung is a new block (same precedent as ISSUE 9/10).
 SCHEMA_VERSION = 7
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
@@ -130,6 +136,10 @@ EVENT_KINDS = frozenset({
                              # renamed aside and will be recomputed
     "serve_worker_restart",  # the serving worker died unexpectedly and the
                              # supervisor restarted it
+    # serve/service.py + utils/compile_cache.py (ISSUE 13)
+    "aot_warm_start",        # warm-up finished its AOT pass (hits/saved/
+                             # buckets attrs — hits == buckets is a fully
+                             # warm cross-process start)
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -217,6 +227,15 @@ METRIC_HELP = {
     "retry_backoff_seconds": "histogram: per retried attempt, the backoff slept before it (capped exponential + seeded jitter)",
     "ckpt_quarantined": "counter: checkpoint chunks renamed aside as corrupt/unreadable at resume (recomputed, not resumed)",
     "serve_worker_restarts": "counter: serving worker threads restarted by the supervisor after an unexpected death",
+    # SNN build observability (ISSUE 13): reverse-edge slot collisions in the
+    # fixed-width [n, 2k] symmetrised graph — edges whose reverse copy lost
+    # the at[].max slot race and contribute weight in one direction only
+    "snn_rev_edges_dropped": "counter: SNN reverse edges dropped to slot collisions in the fixed-width symmetrised graph",
+    # cross-process AOT executable cache (utils/compile_cache.py, ISSUE 13)
+    "aot_cache_hits": "counter: serving executables deserialized from the AOT cache (warm start — no trace)",
+    "aot_cache_misses": "counter: AOT cache lookups with no entry (cold start — trace + serialize)",
+    "aot_cache_saves": "counter: compiled serving executables serialized into the AOT cache",
+    "aot_fallbacks": "counter: present-but-unloadable AOT entries that fell back to trace (loud: warns per entry)",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -308,4 +327,20 @@ CONSENSUS_SPAN_ATTRS = frozenset({
     "candidate_m",        # sparse_knn: candidate-neighbour count per cell
     "accumulated_pairs",  # pairs the accumulator tracked (n*m sparse, n^2 dense)
     "pairs_ratio",        # accumulated_pairs / n^2 — the sub-quadratic ratio
+    # ISSUE 13: SNN build provenance on the consensus_grid spans
+    "snn_impl",              # which SNN_IMPLS entry built the rank weights
+    "snn_rev_edges_dropped", # reverse-edge slot collisions summed over the run
+})
+
+# SNN rank-build implementations (ISSUE 13): the dispatch vocabulary of
+# cluster/engine.resolve_snn_impl — "jax" is the lax.scan build (always
+# available, the CPU/ledger baseline), "pallas" the fused VMEM kernel
+# (ops/pallas_snn.py; TPU default, bit-identical by contract, probed once
+# and degraded to "jax" on any lowering/runtime failure).
+# tools/check_obs_schema.py validates the ``*_SNN_IMPL`` literals in
+# ops/pallas_snn.py against this set, both directions — a renamed impl is a
+# test failure, not a silently unreachable kernel.
+SNN_IMPLS = frozenset({
+    "jax",
+    "pallas",
 })

@@ -1,0 +1,187 @@
+"""Pallas TPU kernel for the SNN rank-weight scan.
+
+The bandwidth-lean variant of cluster/snn.py's ``_rank_halfweights`` family
+(the bluster rank rule w(i, j) = k - r/2, r = min over shared members of the
+rank sum). The XLA lax.scan build streams a [n, k+1, k] compare transient
+through HBM per q step — k+1 round trips of the biggest tensor in the SNN
+build. The kernel here tiles the row axis and runs the whole q loop against
+VMEM-resident tiles: per grid step it holds one [T, k+1] self+neighbour list
+tile and one [T, k, k+1] gathered-neighbour-list tile, and every compare-min
+intermediate lives and dies in VMEM — the transient never touches HBM (the
+same no-HBM-intermediate trick as ops/pallas_cocluster.py), and the output
+is the int16 half-weight lane directly.
+
+The one gather the rank scan needs — neighbour q of neighbour a of row i —
+cannot run inside a row-tiled kernel (it reads arbitrary OTHER rows), so the
+wrapper precomputes ``nlists[i, a, q] = lists[idx[i, a], q]`` as k+1 composed
+cheap gathers (`lists[:, q][idx]`, the same 1-D-indexed form the scan build
+uses; see docs/perf.md on the ~30x row-gather cliff) and hands the kernel a
+gather-free problem.
+
+Two entries mirror the jax lane exactly:
+
+* ``pallas_rank_halfweights(idx)`` — the plain build (every column an edge);
+* ``pallas_rank_halfweights_masked(idx, kv)`` — the padded-k build with a
+  *traced* kv in SMEM, so the fused ``cluster_grid`` vmap over the k axis
+  keeps working (the batching rule broadcasts the row tiles and batches the
+  scalar).
+
+Both are integer-exact: rank sums are small ints, every compare/min/clamp is
+integer arithmetic, so the output is bit-identical to the jax lane (pinned
+by tools/parity_audit.py --pair snn_jax:snn_pallas and the forced-regime
+tests in tests/test_fused_grid.py). Off TPU the kernel runs under
+``interpret=True`` (tier-1 CPU coverage); runtime lowering/execution failure
+degrades to the jax build via cluster/engine.resolve_snn_impl's probe — the
+same warn-and-fall-back contract as the cocluster kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 256      # rows per grid step; [T, k+1, k] int32 compare transient
+#                     at k=20 is ~430 KB VMEM — comfortably resident
+
+# The snn_impl names cluster/engine.py dispatches on (obs.schema.SNN_IMPLS;
+# tools/check_obs_schema.py pins these constants <-> the registry both ways)
+JAX_SNN_IMPL = "jax"
+PALLAS_SNN_IMPL = "pallas"
+
+
+def _sentinel(k: int) -> int:
+    # any rank sum >= 2k clamps the half-weight to 0; matches the jax lane's
+    # cluster/snn._rank_sentinel so intermediate values agree exactly
+    return 2 * k + 4
+
+
+def _interpret() -> bool:
+    """Interpret off-TPU (CPU tier-1 runs the kernel in interpret mode);
+    resolved at trace time — the backend is fixed per process."""
+    return jax.default_backend() != "tpu"
+
+
+def _kernel_plain(lists_ref, nlists_ref, out_ref, *, k: int):
+    lists = lists_ref[...].astype(jnp.int32)                  # [T, k+1]
+    t = lists.shape[0]
+    sent = jnp.int32(_sentinel(k))
+    # 2-D+ iota only (Mosaic): p runs along axis 1 of the [T, k+1, k] cube
+    p_iota = jax.lax.broadcasted_iota(jnp.int32, (t, k + 1, k), 1)
+    r = jnp.full((t, k), sent, jnp.int32)
+    for q in range(k + 1):                                    # static unroll
+        nl_q = nlists_ref[:, :, q].astype(jnp.int32)          # [T, k]
+        mask = lists[:, :, None] == nl_q[:, None, :]          # VMEM-only cube
+        best_p = jnp.min(jnp.where(mask, p_iota, sent), axis=1)
+        r = jnp.minimum(r, best_p + q)
+    out_ref[...] = jnp.maximum(2 * k - r, 0).astype(jnp.int16)
+
+
+def _kernel_masked(kv_ref, lists_ref, nlists_ref, out_ref, *, k: int):
+    kv = kv_ref[0, 0]                                         # traced scalar
+    lists = lists_ref[...].astype(jnp.int32)                  # [T, k+1]
+    t = lists.shape[0]
+    sent = jnp.int32(_sentinel(k))
+    p_iota = jax.lax.broadcasted_iota(jnp.int32, (t, k + 1, k), 1)
+    # list position p valid iff p == 0 (self) or column p-1 < kv, i.e. p <= kv
+    pvalid = p_iota <= kv
+    r = jnp.full((t, k), sent, jnp.int32)
+    for q in range(k + 1):                                    # static unroll
+        nl_q = nlists_ref[:, :, q].astype(jnp.int32)
+        mask = (lists[:, :, None] == nl_q[:, None, :]) & pvalid
+        best_p = jnp.min(jnp.where(mask, p_iota, sent), axis=1)
+        r_new = jnp.minimum(r, best_p + q)
+        r = jnp.where(q <= kv, r_new, r)                      # skip invalid q
+    colv = jax.lax.broadcasted_iota(jnp.int32, (t, k), 1) < kv
+    hw = jnp.maximum(2 * kv - r, 0)
+    out_ref[...] = jnp.where(colv, hw, 0).astype(jnp.int16)
+
+
+def _gathered_lists(idx: jax.Array):
+    """lists [n, k+1] (self at rank 0) and nlists [n, k, k+1] with
+    nlists[i, a, q] = lists[idx[i, a], q] — the cross-row reads hoisted out
+    of the kernel as composed 1-D-indexed gathers."""
+    n, k = idx.shape
+    self_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
+    lists = jnp.concatenate([self_ids, idx], axis=1)          # [n, k+1]
+    nlists = jnp.stack([lists[:, q][idx] for q in range(k + 1)], axis=-1)
+    return lists, nlists
+
+
+def _row_pad(n: int) -> int:
+    tile = min(ROW_TILE, -(-n // 8) * 8)                      # sublane-aligned
+    return tile, -(-n // tile) * tile
+
+
+def _cost(n: int, k: int) -> pl.CostEstimate:
+    return pl.CostEstimate(
+        flops=2 * n * (k + 1) * (k + 1) * k,                  # compare + min
+        bytes_accessed=4 * n * (k + 1) + 4 * n * k * (k + 1) + 2 * n * k,
+        transcendentals=0,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _halfweights_call(idx: jax.Array, interpret: bool) -> jax.Array:
+    n, k = idx.shape
+    tile, n_pad = _row_pad(n)
+    lists, nlists = _gathered_lists(idx)
+    lists = jnp.pad(lists, ((0, n_pad - n), (0, 0)))
+    nlists = jnp.pad(nlists, ((0, n_pad - n), (0, 0), (0, 0)))
+    hw = pl.pallas_call(
+        functools.partial(_kernel_plain, k=k),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, k + 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, k, k + 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, k), jnp.int16),
+        cost_estimate=_cost(n, k),
+        interpret=interpret,
+    )(lists, nlists)
+    return hw[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _halfweights_masked_call(
+    idx: jax.Array, kv: jax.Array, interpret: bool
+) -> jax.Array:
+    n, k = idx.shape
+    tile, n_pad = _row_pad(n)
+    lists, nlists = _gathered_lists(idx)
+    lists = jnp.pad(lists, ((0, n_pad - n), (0, 0)))
+    nlists = jnp.pad(nlists, ((0, n_pad - n), (0, 0), (0, 0)))
+    hw = pl.pallas_call(
+        functools.partial(_kernel_masked, k=k),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec((tile, k + 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, k, k + 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, k), jnp.int16),
+        cost_estimate=_cost(n, k),
+        interpret=interpret,
+    )(jnp.asarray(kv, jnp.int32).reshape(1, 1), lists, nlists)
+    return hw[:n]
+
+
+def pallas_rank_halfweights(idx: jax.Array) -> jax.Array:
+    """int16 half-weights [n, k] — the fused-kernel twin of
+    cluster/snn._rank_halfweights, bit-identical by construction."""
+    return _halfweights_call(jnp.asarray(idx, jnp.int32), _interpret())
+
+
+def pallas_rank_halfweights_masked(idx: jax.Array, kv: jax.Array) -> jax.Array:
+    """int16 masked half-weights [n, k_max] with traced ``kv`` — the
+    fused-kernel twin of cluster/snn._rank_halfweights_masked."""
+    return _halfweights_masked_call(
+        jnp.asarray(idx, jnp.int32), kv, _interpret()
+    )

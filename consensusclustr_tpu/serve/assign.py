@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensusclustr_tpu.serve.artifact import ReferenceArtifact
+from consensusclustr_tpu.utils.compile_cache import counting_jit
 
 DEFAULT_MAX_BATCH = 256
 DEFAULT_K = 15
@@ -93,7 +94,7 @@ def bucket_for(n_rows: int, buckets: Tuple[int, ...]) -> int:
     raise ValueError(f"batch of {n_rows} rows exceeds largest bucket {buckets[-1]}")
 
 
-@functools.partial(jax.jit, static_argnames=("k", "n_classes"))
+@functools.partial(counting_jit, static_argnames=("k", "n_classes"))
 def _assign_batch(
     counts,       # [q, g] float32 raw HVG counts (padded rows all-zero)
     ref_emb,      # [n_ref, d] float32
@@ -141,6 +142,107 @@ def _assign_batch(
     frac = jnp.where(snap, 1.0, frac)
     mean_stab = jnp.where(snap, stability[nearest], mean_stab)
     return winner, frac, mean_stab, dist[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Cross-process AOT warm start (ISSUE 13)
+# ---------------------------------------------------------------------------
+# Per-bucket COMPILED assign executables, keyed in-process by the reference
+# identity + the full static shape of the program. assign_bucketed consults
+# this registry before the counting_jit path: a registered executable is
+# dispatched directly (statics baked in — dynamic args only), skipping trace
+# and lowering entirely. The registry is populated by
+# AssignmentService.warmup(): either deserialized from the on-disk AOT cache
+# (utils/compile_cache.aot_load; the warm-start path — zero traces) or
+# compiled once via prepare_assign_executable and saved back for the next
+# process (the cold path).
+
+_AOT_EXECS: Dict[tuple, object] = {}
+
+
+def artifact_sha(reference: ReferenceArtifact) -> str:
+    """Stable content identity for one reference: the bundle manifest's
+    arrays checksum when the artifact was saved/loaded, else (hand-built
+    artifacts, tests) a sha256 over the array payload. Cached per object."""
+    cached = getattr(reference, "_aot_sha", None)
+    if cached is not None:
+        return cached
+    sha = reference.manifest.get("checksum_sha256") if reference.manifest else None
+    if not sha:
+        import hashlib
+
+        h = hashlib.sha256()
+        for arr in (
+            reference.embedding, reference.mu, reference.sigma,
+            reference.loadings, reference.level_codes, reference.stability,
+        ):
+            a = np.ascontiguousarray(arr)
+            h.update(str(a.shape).encode())
+            h.update(str(a.dtype).encode())
+            h.update(a.tobytes())
+        h.update(np.float32(reference.libsize_mean).tobytes())
+        sha = h.hexdigest()
+    reference._aot_sha = sha
+    return sha
+
+
+def _exec_key(
+    reference: ReferenceArtifact, bucket: int, n_genes: int, k: int,
+    n_classes: int,
+) -> tuple:
+    return (artifact_sha(reference), int(bucket), int(n_genes), int(k),
+            int(n_classes))
+
+
+def register_aot_executable(
+    reference: ReferenceArtifact, bucket: int, n_genes: int, k: int,
+    n_classes: int, compiled,
+) -> None:
+    _AOT_EXECS[_exec_key(reference, bucket, n_genes, k, n_classes)] = compiled
+
+
+def aot_executable_for(
+    reference: ReferenceArtifact, bucket: int, n_genes: int, k: int,
+    n_classes: int,
+):
+    return _AOT_EXECS.get(_exec_key(reference, bucket, n_genes, k, n_classes))
+
+
+def clear_aot_executables() -> None:
+    """Drop every registered executable (tests; frees the linked programs)."""
+    _AOT_EXECS.clear()
+
+
+def _assign_dynamic_args(reference: ReferenceArtifact, padded, snap_eps):
+    """The dynamic operand tuple of one bucket call, in _assign_batch order.
+    prepare_assign_executable lowers on EXACTLY this construction and
+    assign_bucketed calls with it, so the compiled input avals always match."""
+    ref_emb, ref_codes, stability, mu, sigma, loadings, lsm = _device_state(
+        reference
+    )
+    return (padded, ref_emb, ref_codes, stability, mu, sigma, loadings, lsm,
+            np.float32(snap_eps))
+
+
+def prepare_assign_executable(
+    reference: ReferenceArtifact, bucket: int, *, k: int = DEFAULT_K,
+    snap_eps: float = DEFAULT_SNAP_EPS,
+):
+    """Trace+compile the assign program for one bucket shape ahead of time.
+
+    Returns the jax ``Compiled`` (statics baked in; call it with the
+    ``_assign_dynamic_args`` tuple). The trace goes through counting_jit's
+    mirrored ``lower``, so it counts one ``executable_compiles`` exactly like
+    a first dispatch would — the cold/warm delta the bench warm_start rung
+    measures is real trace work, not an accounting artifact.
+    """
+    g = reference.n_hvg
+    n_classes = len(reference.leaf_table)
+    k_eff = int(k)
+    args = _assign_dynamic_args(
+        reference, np.zeros((int(bucket), g), np.float32), snap_eps
+    )
+    return _assign_batch.lower(*args, k=k_eff, n_classes=n_classes).compile()
 
 
 @dataclasses.dataclass
@@ -281,10 +383,22 @@ def assign_bucketed(
         if b != chunk.shape[0]:
             padded = np.zeros((b, chunk.shape[1]), np.float32)
             padded[: chunk.shape[0]] = chunk
-        codes, frac, stab, dist = _assign_batch(
-            padded, ref_emb, ref_codes, stability, mu, sigma, loadings, lsm,
-            np.float32(snap_eps), k=k, n_classes=n_classes,
-        )
+        exe = aot_executable_for(reference, b, chunk.shape[1], int(k), n_classes)
+        if exe is not None:
+            # AOT warm start: dispatch the pre-compiled executable directly
+            # (statics baked in). Counted as a dispatch so the work ledger
+            # stays comparable with the counting_jit path it bypasses.
+            from consensusclustr_tpu.obs import global_metrics
+
+            global_metrics().counter("device_dispatches").inc()
+            codes, frac, stab, dist = exe(
+                *_assign_dynamic_args(reference, padded, snap_eps)
+            )
+        else:
+            codes, frac, stab, dist = _assign_batch(
+                padded, ref_emb, ref_codes, stability, mu, sigma, loadings,
+                lsm, np.float32(snap_eps), k=k, n_classes=n_classes,
+            )
         n = chunk.shape[0]
         for buf, dev in zip(out, (codes, frac, stab, dist)):
             buf[s : s + n] = np.asarray(dev)[:n]

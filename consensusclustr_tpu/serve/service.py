@@ -388,25 +388,68 @@ class AssignmentService:
     # -- lifecycle -----------------------------------------------------------
 
     def warmup(self) -> None:
-        """Compile every bucket shape before traffic arrives.
+        """Ready every bucket shape before traffic arrives.
 
         Calls utils/compile_cache.enable_persistent_cache unconditionally
-        (idempotent; ISSUE 3 satellite), then pushes one all-zero batch per
-        bucket through the real assign program.
+        (idempotent; ISSUE 3 satellite), then per bucket (ISSUE 13): try the
+        cross-process AOT executable cache first — a hit deserializes the
+        fully compiled program (zero traces, the warm start) — else compile
+        it ahead of time and serialize it back for the next process. Either
+        way the executable lands in the serve/assign registry, and one
+        all-zero batch per bucket is pushed through the real assign path.
+        ``CCTPU_NO_AOT_CACHE`` disables the disk cache (in-process AOT
+        compile + registry still run); a present-but-unloadable entry warns
+        and falls back to trace (utils/compile_cache.aot_load).
         """
         from consensusclustr_tpu.utils.compile_cache import (
+            aot_key,
+            aot_load,
+            aot_save,
             enable_persistent_cache,
         )
 
         from consensusclustr_tpu.resilience.inject import SERVE_WARMUP_SITE
         from consensusclustr_tpu.resilience.retry import retry_call
+        from consensusclustr_tpu.serve.assign import (
+            aot_executable_for,
+            artifact_sha,
+            prepare_assign_executable,
+            register_aot_executable,
+        )
 
         enable_persistent_cache()
         g = self.reference.n_hvg
+        n_classes = len(self.reference.leaf_table)
+        use_disk = not os.environ.get("CCTPU_NO_AOT_CACHE")
+        sha = artifact_sha(self.reference)
+        aot_hits = aot_saved = 0
         with self.tracer.span(
             "serve_warmup", buckets=list(self.buckets), n_hvg=g
         ) as sp:
             for b in self.buckets:
+                if aot_executable_for(
+                    self.reference, b, g, self.k, n_classes
+                ) is None:
+                    key = aot_key(
+                        sha, b, genes=g, k=int(self.k), n_classes=n_classes
+                    )
+                    exe = aot_load(key) if use_disk else None
+                    if exe is not None:
+                        aot_hits += 1
+                    else:
+                        try:
+                            exe = prepare_assign_executable(
+                                self.reference, b, k=self.k,
+                                snap_eps=self.snap_eps,
+                            )
+                        except Exception:
+                            exe = None  # the jit path below still compiles it
+                        if exe is not None and use_disk and aot_save(key, exe):
+                            aot_saved += 1
+                    if exe is not None:
+                        register_aot_executable(
+                            self.reference, b, g, self.k, n_classes, exe
+                        )
                 # per-bucket warm-up dispatch under the retry policy: a
                 # transient compile/dispatch failure must not abort the
                 # whole service load
@@ -424,7 +467,16 @@ class AssignmentService:
                     metrics=self.metrics, log=self.tracer,
                 )
                 assert codes.shape == (b,)
-            sp.set(compiles=self._tracker.count)
+            sp.set(
+                compiles=self._tracker.count,
+                aot_hits=aot_hits,
+                aot_saved=aot_saved,
+            )
+        self.tracer.event(
+            "aot_warm_start",
+            hits=aot_hits, saved=aot_saved, buckets=list(self.buckets),
+            disk=bool(use_disk),
+        )
 
     def start(self) -> None:
         if self._closed:

@@ -20,7 +20,11 @@ process. The function returns that resolved state so callers can log it.
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
 import os
+import pickle
+from typing import Optional
 
 import jax
 
@@ -28,6 +32,7 @@ from consensusclustr_tpu.obs import global_metrics
 from consensusclustr_tpu.utils.backend import default_backend
 
 _done = False
+_cache_dir: Optional[str] = None  # resolved XLA cache dir once enabled
 
 
 def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
@@ -150,7 +155,7 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
 
 def enable_persistent_cache() -> bool:
     """Idempotently enable the on-disk XLA cache; True iff it is active."""
-    global _done
+    global _done, _cache_dir
     mets = global_metrics()
     mets.counter("compile_cache_enable_calls").inc()
     if _done or os.environ.get("CCTPU_NO_COMPILE_CACHE"):
@@ -180,9 +185,11 @@ def enable_persistent_cache() -> bool:
         # cache even fast compiles: recursion levels re-enter many small jits
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         enabled = True
+        _cache_dir = cache_dir
         # RunRecord accounting: entry count at enable time (a warm-cache
         # proxy — jax exposes no per-lookup hit counter); a later run with
-        # entries > 0 started warm.
+        # entries > 0 started warm. Re-sampled at run-record attach
+        # (refresh_cache_entries_gauge) so RunRecord shows post-run state.
         try:
             mets.gauge("compile_cache_entries").set(len(os.listdir(cache_dir)))
         except OSError:
@@ -192,3 +199,148 @@ def enable_persistent_cache() -> bool:
     mets.gauge("compile_cache_enabled").set(1 if enabled else 0)
     _done = True
     return enabled
+
+
+def refresh_cache_entries_gauge() -> Optional[int]:
+    """Re-sample ``compile_cache_entries`` from the active cache directory.
+
+    ``enable_persistent_cache`` samples the gauge once at enable time, which
+    meant a RunRecord attached at run END still showed the PRE-run entry
+    count — entries written by the current run were invisible to the
+    warm-start proxy. RunRecord.from_tracer calls this just before snapshotting
+    metrics so the record reflects post-run state. Returns the fresh count,
+    or None when no persistent cache is active (the gauge is then left as
+    the enable path set it)."""
+    if _cache_dir is None:
+        return None
+    try:
+        count = len(os.listdir(_cache_dir))
+    except OSError:
+        return None
+    global_metrics().gauge("compile_cache_entries").set(count)
+    return count
+
+
+# ---------------------------------------------------------------------------
+# Cross-process AOT executable cache (ISSUE 13 tentpole front 3)
+# ---------------------------------------------------------------------------
+# The persistent XLA cache above stores compiled *binaries*, but a fresh
+# process still pays the full trace (tracing + lowering, the dominant serving
+# warm-up cost on CPU/TPU alike) before the binary lookup can even happen.
+# jax.experimental.serialize_executable round-trips the COMPILED executable —
+# trace, lowering and binary — so a warm process can skip straight to a
+# loaded callable. Entries are keyed by (artifact sha256, bucket, jax
+# version, backend): any drift in any component simply misses (a different
+# key), and a present-but-unloadable entry is a LOUD fallback (warning +
+# aot_fallbacks counter), never a crash — trace-from-scratch is always
+# correct.
+
+AOT_CACHE_VERSION = 1
+
+
+def aot_cache_dir() -> str:
+    """The AOT executable cache directory (CCTPU_AOT_CACHE_DIR overrides)."""
+    return os.environ.get(
+        "CCTPU_AOT_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "consensusclustr_tpu", "aot"
+        ),
+    )
+
+
+def aot_key(artifact_sha: str, bucket: int, **extra) -> str:
+    """Deterministic cache key for one serving executable: the reference
+    artifact hash, the padded batch bucket, the jax version and backend (an
+    executable is only loadable into the runtime that serialized it), plus
+    any extra static identity the caller bakes in (k, n_classes, ...)."""
+    ident = {
+        "v": AOT_CACHE_VERSION,
+        "artifact_sha": str(artifact_sha),
+        "bucket": int(bucket),
+        "jax": jax.__version__,
+        "backend": default_backend(),
+        **{k: extra[k] for k in sorted(extra)},
+    }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()
+    ).hexdigest()[:32]
+
+
+def _aot_path(key: str) -> str:
+    return os.path.join(aot_cache_dir(), f"{key}.aotx")
+
+
+def aot_save(key: str, compiled) -> Optional[str]:
+    """Serialize a jax ``Compiled`` to the AOT cache (atomic tmp+rename).
+    Returns the path, or None on any failure (serialization is an
+    optimisation; the counter ``aot_cache_saves`` tracks successes)."""
+    try:
+        from jax.experimental import serialize_executable as _se
+
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        blob = pickle.dumps(
+            {
+                "v": AOT_CACHE_VERSION,
+                "jax": jax.__version__,
+                "backend": default_backend(),
+                "key": key,
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            }
+        )
+        path = _aot_path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        global_metrics().counter("aot_cache_saves").inc()
+        return path
+    except Exception:
+        return None
+
+
+def aot_load(key: str):
+    """Deserialize-and-link the executable cached under ``key``; None on a
+    miss. A PRESENT entry that fails to load (corrupt file, jax/backend
+    mismatch inside the blob, deserializer drift) is the loud fallback: it
+    warns, bumps ``aot_fallbacks``, and returns None so the caller traces
+    from scratch. Hits/misses land on ``aot_cache_hits`` /
+    ``aot_cache_misses``."""
+    mets = global_metrics()
+    path = _aot_path(key)
+    if not os.path.isfile(path):
+        mets.counter("aot_cache_misses").inc()
+        return None
+    try:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if (
+            blob.get("v") != AOT_CACHE_VERSION
+            or blob.get("jax") != jax.__version__
+            or blob.get("backend") != default_backend()
+            or blob.get("key") != key
+        ):
+            raise ValueError(
+                f"AOT entry identity mismatch (entry: jax={blob.get('jax')} "
+                f"backend={blob.get('backend')}; runtime: jax={jax.__version__} "
+                f"backend={default_backend()})"
+            )
+        from jax.experimental import serialize_executable as _se
+
+        loaded = _se.deserialize_and_load(
+            blob["payload"], blob["in_tree"], blob["out_tree"]
+        )
+        mets.counter("aot_cache_hits").inc()
+        return loaded
+    except Exception as e:
+        import warnings
+
+        mets.counter("aot_fallbacks").inc()
+        warnings.warn(
+            f"AOT executable cache entry {os.path.basename(path)} failed to "
+            f"load — falling back to trace ({type(e).__name__}: {e})",
+            RuntimeWarning,
+        )
+        return None

@@ -333,12 +333,13 @@ class TestBenchProbeBudget:
     def test_dispatch_delta_shape(self):
         bench = self._bench()
         before = {"device_dispatches": 3, "executable_compiles": 1,
-                  "donated_bytes": 100, "est_flops": 1000}
+                  "donated_bytes": 100, "est_flops": 1000, "est_bytes": 10}
         after = {"device_dispatches": 7, "executable_compiles": 1,
-                 "donated_bytes": 400, "est_flops": 5000}
+                 "donated_bytes": 400, "est_flops": 5000, "est_bytes": 90}
         delta = bench._dispatch_delta(before, after)
         assert delta == {"device_dispatches": 4, "executable_compiles": 0,
-                         "donated_bytes": 300, "est_flops": 4000}
+                         "donated_bytes": 300, "est_flops": 4000,
+                         "est_bytes": 80}
         # live counters carry every key the payload contract names (the v4
         # est_flops cost rung included)
         live = bench._dispatch_counters()

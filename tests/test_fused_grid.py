@@ -185,6 +185,52 @@ class TestFusedGridParity:
         np.testing.assert_array_equal(np.asarray(sharded[1]), np.asarray(local[1]))
 
 
+# ---------- fused grid with the Pallas SNN kernel forced (ISSUE 13) ----------
+
+
+class TestFusedGridPallasSNN:
+    """The fused-vs-looped parity bar must also hold with the Pallas rank
+    kernel substituted for the lax.scan SNN build — the kernel vmaps under
+    the fused grid's k axis (the masked padded-k variant), so a tiling bug
+    there would break fused while leaving the per-k loop fine."""
+
+    # slow: two extra grid-level interpret-mode pipeline compiles; tier-1
+    # keeps the kernel/graph bit-parity bar via test_snn_int16.py and the
+    # parity_audit snn_jax:snn_pallas preset (tests/test_numerics.py)
+    pytestmark = [
+        pytest.mark.slow,
+        pytest.mark.skipif(
+            not __import__(
+                "consensusclustr_tpu.cluster.engine", fromlist=["_pallas_snn_ok"]
+            )._pallas_snn_ok(),
+            reason="pallas SNN kernel unavailable on this backend",
+        ),
+    ]
+
+    def test_fused_matches_looped_with_pallas_snn(self):
+        x = _blob_pca(n=140, seed=21)
+        key = jax.random.key(4)
+        res = jnp.asarray((0.1, 0.5, 1.0), jnp.float32)
+        args = (key, jnp.asarray(x), res, (6, 10, 15), jnp.float32(0.0))
+        kw = dict(max_clusters=32, snn_impl="pallas")
+        fused = cluster_grid(*args, **kw)
+        looped = cluster_grid_looped(*args, **kw)
+        for a, b in zip(_grid_as_np(fused), _grid_as_np(looped)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_pallas_grid_matches_jax_grid(self):
+        # cross-impl: the whole fused grid is bit-identical across backends,
+        # not just parity within each backend
+        x = _blob_pca(n=120, seed=22)
+        key = jax.random.key(9)
+        res = jnp.asarray((0.2, 0.8), jnp.float32)
+        args = (key, jnp.asarray(x), res, (5, 9), jnp.float32(0.0))
+        a = cluster_grid(*args, max_clusters=32, snn_impl="jax")
+        b = cluster_grid(*args, max_clusters=32, snn_impl="pallas")
+        for fa, fb in zip(_grid_as_np(a), _grid_as_np(b)):
+            np.testing.assert_array_equal(fa, fb)
+
+
 # ---------- donated co-clustering accumulator ----------
 
 

@@ -375,6 +375,31 @@ class TestPerfHistory:
         assert p["wall_trials"]["trials"] >= 1
         assert p["env_health"]["contention_ratio"] >= 1.0
 
+    def test_r13_work_reduction_and_warm_start(self):
+        """ISSUE 13 acceptance, pinned against the committed artifacts: the
+        int16 half-weight lane + headline-scoped flat window cut the default
+        rung's estimated work below r12 on a bit-identical workload (same
+        labels fingerprint, same deterministic work ledger), the new
+        ``est_bytes`` flat key is populated, and the AOT warm-start rung
+        shows the warm path compiling strictly fewer executables than cold
+        with every bucket served from the cache."""
+        ph = _load_tool("perf_history")
+        rows = {r["round"]: r for r in ph.collect(REPO_ROOT)}
+        p12, p13 = rows[12]["payload"], rows[13]["payload"]
+        assert p13 is not None and p13["obs_schema"] == 7
+        # identical workload, identical deterministic ledger
+        assert p13["labels_fingerprint"] == p12["labels_fingerprint"]
+        assert p13["work_ledger"]["counters"] == p12["work_ledger"]["counters"]
+        # lower estimated work on the (now headline-scoped) flat keys
+        assert p13["est_flops"] < p12["est_flops"]
+        assert p13["est_bytes"] > 0 and "est_bytes" not in p12
+        assert p13["executable_compiles"] <= p12["executable_compiles"]
+        # cross-process warm start: cache fully warm, zero warm compiles
+        ws = p13["warm_start"]
+        assert ws["warm_compiles"] < ws["cold_compiles"]
+        assert ws["warm_aot_hits"] == ws["aot_entries"] == ws["buckets"]
+        assert ws["warm_warmup_s"] < ws["cold_warmup_s"]
+
     def test_synthetic_regression_series_gates(self, tmp_path, capsys):
         ph = _load_tool("perf_history")
         (tmp_path / "BENCH_r01.json").write_text(json.dumps(

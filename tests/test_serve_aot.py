@@ -1,0 +1,306 @@
+"""Cross-process AOT warm start for serving — ISSUE 13 tentpole front 3.
+
+The persistent XLA cache (ISSUE 3) skips re-optimization, but a fresh process
+still pays the full trace per bucket before the binary lookup even runs.
+jax.experimental.serialize_executable round-trips the COMPILED assign
+program, so a warm process deserializes straight to a callable: zero traces.
+These tests pin the key/serialize/load plumbing (utils/compile_cache), the
+in-process executable registry (serve/assign), the loud fallback-to-trace on
+an unloadable entry, the service warm-up integration — and the headline
+claim, via two genuinely cold child interpreters sharing one cache dir: the
+warm process reports strictly fewer ``executable_compiles`` than the cold one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.obs import Tracer, global_metrics
+from consensusclustr_tpu.serve.artifact import ReferenceArtifact, level_tables
+from consensusclustr_tpu.serve.assign import (
+    DEFAULT_K,
+    DEFAULT_SNAP_EPS,
+    _assign_batch,
+    _assign_dynamic_args,
+    artifact_sha,
+    aot_executable_for,
+    assign_bucketed,
+    clear_aot_executables,
+    embed_reference_counts,
+    prepare_assign_executable,
+    register_aot_executable,
+)
+from consensusclustr_tpu.serve.service import AssignmentService
+from consensusclustr_tpu.utils.compile_cache import (
+    AOT_CACHE_VERSION,
+    _aot_path,
+    aot_cache_dir,
+    aot_key,
+    aot_load,
+    aot_save,
+)
+
+
+def _counter(name: str) -> float:
+    c = global_metrics().counters.get(name)
+    return float(c.value) if c is not None else 0.0
+
+
+def _artifact(n=48, g=20, d=4, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    loadings = np.linalg.qr(rng.normal(size=(g, d)))[0].astype(np.float32)
+    mu = rng.gamma(1.0, 1.0, g).astype(np.float32)
+    sigma = np.ones(g, np.float32)
+    counts = rng.poisson(2.0, size=(n, g)).astype(np.float32)
+    libsize_mean = float(counts.sum(axis=1).mean())
+    emb = embed_reference_counts(counts, mu, sigma, loadings, libsize_mean)
+    codes, tables = level_tables(
+        np.asarray([str(c + 1) for c in rng.integers(0, n_classes, n)])
+    )
+    return ReferenceArtifact(
+        embedding=emb, mu=mu, sigma=sigma, loadings=loadings,
+        libsize_mean=libsize_mean, level_codes=codes, level_tables=tables,
+        stability=np.ones(len(tables[-1]), np.float32), pc_num=d,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _isolated_aot(tmp_path, monkeypatch):
+    """Every test gets its own cache dir and a clean in-process registry."""
+    monkeypatch.setenv("CCTPU_AOT_CACHE_DIR", str(tmp_path / "aot"))
+    monkeypatch.delenv("CCTPU_NO_AOT_CACHE", raising=False)
+    clear_aot_executables()
+    yield
+    clear_aot_executables()
+
+
+# ---------- key identity ----------
+
+
+class TestAotKey:
+    def test_deterministic_and_sensitive(self):
+        a = aot_key("sha0", 8, genes=20, k=30, n_classes=3)
+        assert a == aot_key("sha0", 8, genes=20, k=30, n_classes=3)
+        assert a != aot_key("sha1", 8, genes=20, k=30, n_classes=3)
+        assert a != aot_key("sha0", 16, genes=20, k=30, n_classes=3)
+        assert a != aot_key("sha0", 8, genes=21, k=30, n_classes=3)
+        assert len(a) == 32 and int(a, 16) >= 0
+
+    def test_artifact_sha_prefers_manifest(self, tmp_path):
+        art = _artifact()
+        hand = artifact_sha(art)
+        assert hand == artifact_sha(art)  # cached, stable
+        path = str(tmp_path / "ref")
+        art.save(path)
+        loaded = ReferenceArtifact.load(path)
+        assert artifact_sha(loaded) == loaded.manifest["checksum_sha256"]
+
+    def test_artifact_sha_distinguishes_content(self):
+        assert artifact_sha(_artifact(seed=1)) != artifact_sha(_artifact(seed=2))
+
+
+# ---------- serialize / load round trip ----------
+
+
+class TestAotRoundTrip:
+    def test_save_load_executes_identically(self):
+        art = _artifact()
+        bucket, g = 8, art.n_hvg
+        comp = prepare_assign_executable(art, bucket)
+        key = aot_key(artifact_sha(art), bucket, genes=g, k=DEFAULT_K,
+                      n_classes=len(art.leaf_table))
+        before = {k: _counter(f"aot_cache_{k}") for k in ("saves", "hits")}
+        path = aot_save(key, comp)
+        assert path is not None and os.path.isfile(path)
+        assert path.startswith(aot_cache_dir())
+        assert _counter("aot_cache_saves") == before["saves"] + 1
+        loaded = aot_load(key)
+        assert loaded is not None
+        assert _counter("aot_cache_hits") == before["hits"] + 1
+        padded = np.random.default_rng(3).poisson(
+            2.0, size=(bucket, g)
+        ).astype(np.float32)
+        args = _assign_dynamic_args(art, padded, DEFAULT_SNAP_EPS)
+        got = loaded(*args)
+        ref = comp(*args)
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_missing_entry_counts_a_miss(self):
+        before = _counter("aot_cache_misses")
+        assert aot_load(aot_key("nope", 4)) is None
+        assert _counter("aot_cache_misses") == before + 1
+
+    def test_corrupt_entry_is_loud_fallback(self):
+        key = aot_key("corrupt", 4)
+        os.makedirs(aot_cache_dir(), exist_ok=True)
+        with open(_aot_path(key), "wb") as f:
+            f.write(b"not a pickle at all")
+        before = _counter("aot_fallbacks")
+        with pytest.warns(RuntimeWarning, match="AOT"):
+            assert aot_load(key) is None
+        assert _counter("aot_fallbacks") == before + 1
+
+    def test_runtime_identity_mismatch_is_loud_fallback(self):
+        key = aot_key("stale", 4)
+        os.makedirs(aot_cache_dir(), exist_ok=True)
+        blob = {
+            "v": AOT_CACHE_VERSION, "jax": "0.0.1", "backend": "tpu",
+            "key": key, "payload": b"", "in_tree": None, "out_tree": None,
+        }
+        with open(_aot_path(key), "wb") as f:
+            f.write(pickle.dumps(blob))
+        before = _counter("aot_fallbacks")
+        with pytest.warns(RuntimeWarning, match="mismatch"):
+            assert aot_load(key) is None
+        assert _counter("aot_fallbacks") == before + 1
+
+
+# ---------- in-process registry + dispatch parity ----------
+
+
+class TestAotRegistry:
+    def test_registered_executable_serves_bitwise_identically(self):
+        art = _artifact(seed=5)
+        g = art.n_hvg
+        n_classes = len(art.leaf_table)
+        rng = np.random.default_rng(9)
+        counts = rng.poisson(2.0, size=(6, g)).astype(np.float32)
+        ref = assign_bucketed(art, counts, buckets=(8,))
+        comp = prepare_assign_executable(art, 8)
+        register_aot_executable(art, 8, g, DEFAULT_K, n_classes, comp)
+        assert aot_executable_for(art, 8, g, DEFAULT_K, n_classes) is comp
+        got = assign_bucketed(art, counts, buckets=(8,))
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_registry_keys_by_artifact_content(self):
+        a, b = _artifact(seed=1), _artifact(seed=2)
+        g, n_classes = a.n_hvg, len(a.leaf_table)
+        comp = prepare_assign_executable(a, 4)
+        register_aot_executable(a, 4, g, DEFAULT_K, n_classes, comp)
+        assert aot_executable_for(b, 4, g, DEFAULT_K, n_classes) is None
+        clear_aot_executables()
+        assert aot_executable_for(a, 4, g, DEFAULT_K, n_classes) is None
+
+    def test_registry_dispatch_still_counts_dispatches(self):
+        art = _artifact(seed=7)
+        comp = prepare_assign_executable(art, 4)
+        register_aot_executable(
+            art, 4, art.n_hvg, DEFAULT_K, len(art.leaf_table), comp
+        )
+        counts = np.random.default_rng(1).poisson(
+            2.0, size=(3, art.n_hvg)
+        ).astype(np.float32)
+        before = _counter("device_dispatches")
+        assign_bucketed(art, counts, buckets=(4,))
+        assert _counter("device_dispatches") == before + 1
+
+
+# ---------- service warm-up integration ----------
+
+
+class TestServiceWarmup:
+    def test_warmup_populates_cache_then_hits_it(self):
+        art = _artifact(seed=11)
+        tracer = Tracer()
+        svc = AssignmentService(
+            art, buckets=(2, 4), max_batch=4, warmup=True, start=False,
+            tracer=tracer
+        )
+        svc.close()
+        cache = aot_cache_dir()
+        assert sorted(os.listdir(cache)) and all(
+            f.endswith(".aotx") for f in os.listdir(cache)
+        )
+        ev = [e for e in tracer.events if e["kind"] == "aot_warm_start"]
+        assert ev and ev[-1]["saved"] == 2 and ev[-1]["disk"] is True
+        # a "new process" (registry cleared) warms entirely from disk
+        clear_aot_executables()
+        tracer2 = Tracer()
+        svc2 = AssignmentService(
+            art, buckets=(2, 4), max_batch=4, warmup=True, start=False,
+            tracer=tracer2
+        )
+        svc2.close()
+        ev2 = [e for e in tracer2.events if e["kind"] == "aot_warm_start"]
+        assert ev2 and ev2[-1]["hits"] == 2 and ev2[-1]["saved"] == 0
+
+    def test_kill_switch_keeps_disk_untouched(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_NO_AOT_CACHE", "1")
+        art = _artifact(seed=12)
+        tracer = Tracer()
+        svc = AssignmentService(
+            art, buckets=(2,), max_batch=2, warmup=True, start=False,
+            tracer=tracer
+        )
+        svc.close()
+        assert not os.path.isdir(aot_cache_dir()) or not os.listdir(
+            aot_cache_dir()
+        )
+        ev = [e for e in tracer.events if e["kind"] == "aot_warm_start"]
+        assert ev and ev[-1]["disk"] is False
+
+
+# ---------- the headline: cold process vs warm process ----------
+
+
+_CHILD = """
+import json, sys
+from consensusclustr_tpu.serve.artifact import ReferenceArtifact
+from consensusclustr_tpu.serve.service import AssignmentService
+from consensusclustr_tpu.obs import global_metrics
+
+art = ReferenceArtifact.load(sys.argv[1])
+svc = AssignmentService(art, buckets=(4, 8), max_batch=8, warmup=True,
+                        start=False)
+svc.close()
+c = global_metrics().counters
+print(json.dumps({
+    k: int(c[k].value) if k in c else 0
+    for k in ("executable_compiles", "aot_cache_hits", "aot_cache_saves",
+              "aot_fallbacks")
+}))
+"""
+
+
+class TestCrossProcessWarmStart:
+    def test_warm_child_compiles_strictly_less(self, tmp_path):
+        """Two cold interpreters, one cache dir: the first traces + compiles
+        and serializes per bucket; the second deserializes per bucket and
+        must report strictly fewer executable_compiles — the cross-process
+        warm start the bench ``warm_start`` rung measures."""
+        art = _artifact(n=64, g=24, seed=13)
+        art_path = str(tmp_path / "ref")
+        art.save(art_path)
+        env = dict(
+            os.environ,
+            CCTPU_AOT_CACHE_DIR=str(tmp_path / "aot"),
+            JAX_PLATFORMS="cpu",
+        )
+        env.pop("CCTPU_SERVE_METRICS_PORT", None)
+        env.pop("CCTPU_NO_AOT_CACHE", None)
+
+        def child():
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD, art_path],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, timeout=300,
+            )
+            assert proc.returncode == 0
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        cold = child()
+        assert cold["aot_cache_saves"] == 2 and cold["aot_cache_hits"] == 0
+        assert cold["executable_compiles"] >= 2  # traced every bucket
+        warm = child()
+        assert warm["aot_cache_hits"] == 2 and warm["aot_cache_saves"] == 0
+        assert warm["aot_fallbacks"] == 0
+        assert warm["executable_compiles"] < cold["executable_compiles"]

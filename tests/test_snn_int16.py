@@ -1,0 +1,254 @@
+"""Exact low-precision SNN lanes + the fused Pallas rank kernel — ISSUE 13.
+
+The rank weight k - r/2 is a dyadic rational, so its half-weight 2k - r is an
+exact small integer: the build/symmetrise/degree hot path carries int16 and
+converts to f32 only at the Leiden boundary. These tests pin that the lane is
+*integer-exact* (bit-identical to the mathematically exact f64 arithmetic,
+which the historical f32 build also computed), that the Pallas compare-min
+kernel matches the lax.scan build bit for bit, that the reverse-slot
+collision count is exact, and — the guardrail in reverse — that PR 8's bf16
+injection machinery WOULD catch a precision downgrade planted into the lane,
+so the exactness assertions here have teeth.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.cluster.engine import (
+    SNN_IMPLS,
+    _pallas_snn_ok,
+    resolve_snn_impl,
+)
+from consensusclustr_tpu.cluster.knn import knn_points
+from consensusclustr_tpu.cluster.snn import (
+    _rank_halfweights,
+    _rank_halfweights_masked,
+    snn_graph,
+)
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.pipeline import (
+    SNN_IMPL_ATTR,
+    SNN_REV_DROPPED_ATTR,
+    consensus_cluster,
+)
+from consensusclustr_tpu.obs import Tracer
+from consensusclustr_tpu.obs.fingerprint import (
+    NumericsMonitor,
+    _apply_inject,
+    array_fingerprint,
+    parse_inject,
+)
+from consensusclustr_tpu.utils.log import LevelLog
+from consensusclustr_tpu.utils.rng import root_key
+
+needs_pallas_snn = pytest.mark.skipif(
+    not _pallas_snn_ok(), reason="pallas SNN kernel unavailable on this backend"
+)
+
+
+def _points(n=120, d=5, seed=0):
+    r = np.random.default_rng(seed)
+    return r.normal(size=(n, d)).astype(np.float32)
+
+
+def _brute_halfweights(idx: np.ndarray) -> np.ndarray:
+    """O(n k (k+1)^2) int64 oracle of the rank half-weight definition:
+    hw[i, a] = max(2k - r, 0), r = min over shared members m of
+    rank_i(m) + rank_j(m), with each node at rank 0 of its own list."""
+    idx = np.asarray(idx)
+    n, k = idx.shape
+    lists = np.concatenate([np.arange(n)[:, None], idx], axis=1)
+    hw = np.zeros((n, k), np.int64)
+    for i in range(n):
+        for a in range(k):
+            j = int(idx[i, a])
+            r = min(
+                p + q
+                for p, mp in enumerate(lists[i])
+                for q, mq in enumerate(lists[j])
+                if mp == mq
+            )
+            hw[i, a] = max(2 * k - r, 0)
+    return hw
+
+
+# ---------- integer exactness of the int16 lane ----------
+
+
+class TestInt16Exactness:
+    def test_halfweights_match_int64_oracle(self):
+        idx, _ = knn_points(jnp.asarray(_points(n=60, seed=1)), 8)
+        hw = np.asarray(_rank_halfweights(idx))
+        assert hw.dtype == np.int16
+        np.testing.assert_array_equal(hw, _brute_halfweights(np.asarray(idx)))
+
+    def test_masked_halfweights_match_sliced_oracle(self):
+        idx, _ = knn_points(jnp.asarray(_points(n=50, seed=2)), 10)
+        for kv in (3, 7, 10):
+            got = np.asarray(_rank_halfweights_masked(idx, jnp.int32(kv)))
+            assert got.dtype == np.int16
+            ref = _brute_halfweights(np.asarray(idx)[:, :kv])
+            np.testing.assert_array_equal(got[:, :kv], ref)
+            assert (got[:, kv:] == 0).all()
+
+    def test_f32_boundary_is_bitwise_exact(self):
+        """The Leiden-boundary conversion reproduces exact f64 arithmetic bit
+        for bit: w = hw/2 elementwise, deg = f64 row-sum of w cast to f32
+        (per-row degrees are < 2^24 half-units, so the int32-sum * 0.5 lane
+        IS the exact value), and two_m the exact f64 total cast to f32."""
+        idx, _ = knn_points(jnp.asarray(_points(n=200, d=6, seed=3)), 20)
+        g = snn_graph(idx)
+        w = np.asarray(g.w)
+        assert w.dtype == np.float32
+        # slot weights: exact halves of small integers
+        hw64 = (w.astype(np.float64) * 2).round().astype(np.int64)
+        np.testing.assert_array_equal(w, (hw64.astype(np.float64) / 2).astype(np.float32))
+        # degrees: exact f64 row sums, cast once
+        np.testing.assert_array_equal(
+            np.asarray(g.deg),
+            (hw64.sum(axis=1).astype(np.float64) / 2).astype(np.float32),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(g.two_m),
+            np.float32(hw64.sum(dtype=np.int64).astype(np.float64) / 2),
+        )
+
+    def test_bf16_injection_would_be_caught(self):
+        """The guardrail has teeth: planting PR 8's bf16 downgrade into the
+        degree lane CHANGES the values (degrees need more than bf16's 8
+        mantissa bits past 256 half-units) and flips the checksum the parity
+        auditor diffs — so the exactness pins above cannot pass by accident
+        on a secretly-lossy lane."""
+        idx, _ = knn_points(jnp.asarray(_points(n=200, d=6, seed=3)), 20)
+        deg = np.asarray(snn_graph(idx).deg)
+        assert (deg > 256).any()  # magnitudes where bf16 must round
+        mon = NumericsMonitor("audit", parse_inject("bf16:consensus_dist"))
+        (hurt,) = _apply_inject(mon, "consensus_dist", [jnp.asarray(deg)])
+        assert not np.array_equal(deg, np.asarray(hurt))
+        assert (
+            array_fingerprint(deg)["checksum"]
+            != array_fingerprint(hurt)["checksum"]
+        )
+        # ...and a checkpoint the injection does NOT name stays untouched
+        (clean,) = _apply_inject(mon, "labels", [jnp.asarray(deg)])
+        np.testing.assert_array_equal(deg, np.asarray(clean))
+
+
+# ---------- pallas kernel bit-parity ----------
+
+
+@needs_pallas_snn
+class TestPallasParity:
+    def test_plain_kernel_bitwise(self):
+        from consensusclustr_tpu.ops.pallas_snn import pallas_rank_halfweights
+
+        for n, k, seed in ((60, 8, 1), (300, 15, 4), (9, 12, 5)):
+            idx, _ = knn_points(jnp.asarray(_points(n=n, seed=seed)), k)
+            a = np.asarray(_rank_halfweights(idx))
+            b = np.asarray(pallas_rank_halfweights(idx))
+            assert b.dtype == np.int16
+            np.testing.assert_array_equal(a, b)
+
+    def test_masked_kernel_bitwise(self):
+        from consensusclustr_tpu.ops.pallas_snn import (
+            pallas_rank_halfweights_masked,
+        )
+
+        idx, _ = knn_points(jnp.asarray(_points(n=80, seed=6)), 12)
+        for kv in (1, 5, 12):
+            a = np.asarray(_rank_halfweights_masked(idx, jnp.int32(kv)))
+            b = np.asarray(pallas_rank_halfweights_masked(idx, jnp.int32(kv)))
+            np.testing.assert_array_equal(a, b)
+
+    def test_snn_graph_end_to_end_bitwise(self):
+        idx, _ = knn_points(jnp.asarray(_points(n=100, seed=7)), 10)
+        a = snn_graph(idx, snn_impl="jax")
+        b = snn_graph(idx, snn_impl="pallas")
+        for fa, fb in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+# ---------- reverse-slot collision accounting ----------
+
+
+class TestRevDropped:
+    def test_collision_pin(self):
+        """Two sources (0 and 1) both name node 2 as their rank-0 neighbour
+        and neither edge is mutual: slot (2, 0) can hold one reverse edge, so
+        exactly one duplicate is dropped — and counted."""
+        idx = jnp.asarray([[2], [2], [3], [0]], jnp.int32)
+        g = snn_graph(idx)
+        assert int(g.rev_dropped) == 1
+
+    def test_no_collisions_on_mutual_ring(self):
+        # 0<->1 and 2<->3 are mutual: no reverse slots wanted, none dropped
+        idx = jnp.asarray([[1], [0], [3], [2]], jnp.int32)
+        assert int(snn_graph(idx).rev_dropped) == 0
+
+    @pytest.mark.slow  # one full pipeline compile just for the attr plumbing
+    def test_pipeline_surfaces_counter_and_span_attr(self):
+        r = np.random.default_rng(11)
+        centers = r.normal(0.0, 6.0, size=(3, 5))
+        pca = (
+            centers[r.integers(0, 3, size=90)] + r.normal(0, 1.0, size=(90, 5))
+        ).astype(np.float32)
+        cfg = ClusterConfig(nboots=4, k_num=(6,), res_range=(0.3, 0.8))
+        tracer = Tracer()
+        consensus_cluster(
+            root_key(5), jnp.asarray(pca), cfg, log=LevelLog(tracer=tracer)
+        )
+        attrs = {}
+        for root in tracer.roots:
+            for _, sp in root.walk():
+                if sp.name == "consensus_grid":
+                    attrs = sp.attrs
+        assert attrs[SNN_IMPL_ATTR] in SNN_IMPLS
+        assert attrs[SNN_REV_DROPPED_ATTR] >= 0
+        c = tracer.metrics.counters.get("snn_rev_edges_dropped")
+        assert c is not None and int(c.value) == attrs[SNN_REV_DROPPED_ATTR]
+
+
+# ---------- backend resolution / degrade contract ----------
+
+
+class TestResolveSnnImpl:
+    def test_explicit_beats_env(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_SNN_IMPL", "pallas")
+        assert resolve_snn_impl("jax") == "jax"
+
+    def test_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_SNN_IMPL", "jax")
+        assert resolve_snn_impl() == "jax"
+
+    def test_cpu_default_is_jax(self, monkeypatch):
+        import jax
+
+        monkeypatch.delenv("CCTPU_SNN_IMPL", raising=False)
+        if jax.default_backend() != "tpu":
+            assert resolve_snn_impl() == "jax"
+
+    def test_kill_switch_forces_jax(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_NO_PALLAS", "1")
+        assert resolve_snn_impl("pallas") == "jax"
+
+    def test_unknown_impl_raises(self):
+        with pytest.raises(ValueError, match="snn impl"):
+            resolve_snn_impl("cuda")
+
+    def test_unknown_impl_in_snn_graph_raises(self):
+        idx = jnp.zeros((4, 2), jnp.int32)
+        with pytest.raises(ValueError, match="snn_impl"):
+            snn_graph(idx, snn_impl="nope")
+
+    def test_schema_registry_matches_engine(self):
+        from consensusclustr_tpu.obs import schema
+
+        assert set(SNN_IMPLS) == set(schema.SNN_IMPLS)
+        for name in (SNN_IMPL_ATTR, SNN_REV_DROPPED_ATTR):
+            assert name in schema.CONSENSUS_SPAN_ATTRS
+        assert "snn_rev_edges_dropped" in schema.METRIC_NAMES

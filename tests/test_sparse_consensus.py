@@ -567,7 +567,7 @@ class TestToolingSurfaces:
 
         attrs = {
             pl.REGIME_ATTR, pl.CANDIDATE_M_ATTR, pl.PAIRS_ATTR,
-            pl.PAIRS_RATIO_ATTR,
+            pl.PAIRS_RATIO_ATTR, pl.SNN_IMPL_ATTR, pl.SNN_REV_DROPPED_ATTR,
         }
         assert attrs == set(obs_schema.CONSENSUS_SPAN_ATTRS)
         assert "candidates" in obs_schema.SPAN_NAMES

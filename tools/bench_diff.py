@@ -143,6 +143,19 @@ RUNGS: Dict[str, int] = {
     "sparse_consensus.peak_rss_mb": -1,
     "sparse_consensus.cocluster_rss_peak_mb": -1,
     "sparse_consensus.carry_mb": -1,
+    # cost-model bytes denominator (ISSUE 13): the bandwidth twin of
+    # est_flops — fewer estimated bytes accessed for the same workload = win
+    "est_bytes": -1,
+    # cross-process AOT warm start (ISSUE 13): the warm process must trace
+    # strictly less than the cold one, and its warm-up wall should shrink —
+    # warm_compiles regressing back to cold_compiles means the serialized
+    # executables stopped loading (key drift, deserializer break)
+    "warm_start.cold_compiles": -1,
+    "warm_start.warm_compiles": -1,
+    "warm_start.cold_warmup_s": -1,
+    "warm_start.warm_warmup_s": -1,
+    "warm_start.warm_aot_hits": +1,
+    "warm_start.aot_entries": +1,
 }
 
 # Gate-spec shorthands: --gate compiles:0.9 reads better than the full
@@ -158,6 +171,9 @@ RUNG_ALIASES: Dict[str, str] = {
     # ISSUE 9: the sparse-consensus memory gate — the consensus phase's own
     # RSS watermark at the >= 8x rung (sub-quadratic or bust)
     "sparse_rss": "sparse_consensus.cocluster_rss_peak_mb",
+    # ISSUE 13: the cost-model bytes gate and the warm-start trace gate
+    "bytes": "est_bytes",
+    "warm_compiles": "warm_start.warm_compiles",
 }
 
 # Wall-derived rungs whose regressions the noise-aware downgrade (high

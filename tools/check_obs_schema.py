@@ -96,6 +96,10 @@ CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""
 SITE_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_SITE)\s*=\s*["']([A-Za-z0-9_]+)["']""")
 # obs/ledger.py work-counter constants: NAME_WORK = "literal"
 WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# ops/pallas_snn.py SNN-impl constants: NAME_SNN_IMPL = "literal"
+SNN_IMPL_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_SNN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
 # literal site names at fault-spec strings in tools/chaos_audit.py presets:
 # "site:kind[:arg]" — the first segment must be a registered fault site
 SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
@@ -371,6 +375,34 @@ def check_work_ledger(root: str) -> List[str]:
     return errors
 
 
+def check_snn_impls(root: str) -> List[str]:
+    """ISSUE 13: the SNN-implementation registry, both directions.
+
+    * ops/pallas_snn.py ``*_SNN_IMPL`` literals <-> schema.SNN_IMPLS
+      (complete: every registered impl must have a defining constant — the
+      dispatch vocabulary lives where the kernel does, so an unbacked
+      registry entry is an impl nothing can select);
+    * cluster/engine.py's ``SNN_IMPLS`` dispatch tuple is ast-pinned to the
+      registry (set equality) — resolve_snn_impl must accept exactly the
+      registered vocabulary.
+    """
+    errors = _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "ops", "pallas_snn.py"),
+        SNN_IMPL_RE, "SNN_IMPLS", "snn impl", require_complete=True,
+    )
+    engine = os.path.join(root, "consensusclustr_tpu", "cluster", "engine.py")
+    registry = getattr(schema, "SNN_IMPLS", None)
+    if registry is not None and os.path.isfile(engine):
+        got = _literal_assign(engine, "SNN_IMPLS")
+        if got is not None and set(got) != set(registry):
+            errors.append(
+                "consensusclustr_tpu/cluster/engine.py: SNN_IMPLS drifted "
+                f"from obs.schema.SNN_IMPLS (got {sorted(got)!r}, expected "
+                f"{sorted(registry)!r})"
+            )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
@@ -380,6 +412,7 @@ def check(root: str) -> List[str]:
         + check_consensus_attrs(root)
         + check_fault_sites(root)
         + check_work_ledger(root)
+        + check_snn_impls(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

@@ -72,6 +72,13 @@ PAIRS: Dict[str, Tuple[dict, dict]] = {
         {"env": {"CCTPU_GRID_IMPL": "fused"}},
         {"env": {"CCTPU_GRID_IMPL": "looped"}},
     ),
+    # ISSUE 13: the jax scan SNN build vs the fused Pallas rank kernel.
+    # Same int16 half-weight arithmetic, different schedule — must be
+    # bit-identical (interpret=True off-TPU makes this runnable anywhere).
+    "snn_jax:snn_pallas": (
+        {"env": {"CCTPU_SNN_IMPL": "jax"}},
+        {"env": {"CCTPU_SNN_IMPL": "pallas"}},
+    ),
     "depth1:depth4": ({"pipeline_depth": 1}, {"pipeline_depth": 4}),
     "x64:x32": ({"x64": True}, {"x64": False}),
     # ISSUE 9: the dense [n, n] oracle vs the kNN-restricted sparse

@@ -57,6 +57,7 @@ FLAT_LEDGER_KEYS = {
     "executable_compiles": "executable_compiles",
     "donated_bytes": "donated_bytes",
     "est_flops": "estimated_flops",
+    "est_bytes": "estimated_bytes_accessed",
 }
 
 # wall ratio between adjacent rounds that earns a divergence annotation

@@ -392,7 +392,28 @@ _SERVING_SLO_ZERO = {
     "serving_slo": {"steps": []},
     "serving_p99_ms": 0.0,
     "serve_rejection_rate": 0.0,
+    # ISSUE 14: the saturation step's SLO alert state + any flight-recorder
+    # post-mortem written during the rung — carried on every rung
+    # (including failure) so BENCH_*.json lines stay key-comparable
+    "alerts": {
+        "active": [], "raised_total": 0, "cleared_total": 0,
+        "last_alert": None,
+    },
+    "postmortem_path": None,
 }
+
+
+def _postmortem_path():
+    """The process flight recorder's last dump path (obs/flight.py), or
+    None on a clean run / unimportable package — the failure rung's
+    breadcrumb to the black box."""
+    try:
+        from consensusclustr_tpu.obs.flight import global_flight
+
+        rec = global_flight()
+        return rec.last_dump_path if rec is not None else None
+    except Exception:
+        return None
 
 # The warm-start rung's zero shape (ISSUE 13) — emitted verbatim on the
 # failure rung so BENCH_*.json lines stay key-comparable across rounds.
@@ -685,11 +706,23 @@ def _serving_slo_rung() -> dict:
         out["serve_rejection_rate"] = (
             float(sat["rejection_rate"]) if sat else 0.0
         )
+        # ISSUE 14: the saturation step's alert state (each ladder step
+        # carries one — loadgen.step_alerts) lands top-level next to the
+        # p99/rejection numbers it judges, plus the flight-recorder
+        # breadcrumb (None on a clean rung: the recorder only writes on
+        # failure).
+        out["alerts"] = (
+            dict((sat or {}).get("alerts") or {})
+            or {k: (list(v) if isinstance(v, list) else v)
+                for k, v in _SERVING_SLO_ZERO["alerts"].items()}
+        )
+        out["postmortem_path"] = _postmortem_path()
         return out
     except Exception as e:
         out = {k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()}
         out["serving_slo"]["error"] = str(e)[:200]
+        out["postmortem_path"] = _postmortem_path()
         return out
 
 
@@ -1362,6 +1395,8 @@ def main() -> None:
             "serving": dict(_SERVING_ZERO),
             **{k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()},
+            # a failed rung is exactly when a flight dump exists — point at it
+            "postmortem_path": _postmortem_path(),
             "sparse_consensus": dict(_SPARSE_CONSENSUS_ZERO),
             "warm_start": dict(_WARM_START_ZERO),
             "probe_s": probe_s,

@@ -877,6 +877,16 @@ def consensus_clust(
     from consensusclustr_tpu.obs.ledger import attach_ledger
 
     attach_ledger(tracer)
+    # Flight recorder + SLO alert engine (obs/flight.py / obs/alerts.py,
+    # ISSUE 14): the recorder is on by default — bounded rings that only
+    # ever WRITE on failure (unhandled exception, SIGTERM/SIGINT, retry
+    # exhaustion, stall) — and the alert engine evaluates its rules at
+    # record time below. CCTPU_NO_FLIGHT=1 disarms recorder + watchdog.
+    from consensusclustr_tpu.obs.alerts import attach_alerts
+    from consensusclustr_tpu.obs.flight import attach_flight
+
+    attach_flight(tracer)
+    attach_alerts(tracer)
     log = LevelLog(enabled=cfg.progress, tracer=tracer)
     key = root_key(cfg.seed)
 
@@ -910,19 +920,34 @@ def _consensus_clust_run(
     start/stop brackets the whole run without re-indenting the pipeline)."""
     from consensusclustr_tpu.utils.backend import default_backend
 
-    with tracer.span("ingest"):
+    # Per-phase stall watchdog (obs/flight.py, ISSUE 14): deadlines derive
+    # from the live phase_seconds histogram (p99 x CCTPU_STALL_FACTOR) with
+    # the cfg/env floor; expiry dumps all-thread stacks + a stall_detected
+    # event but never kills the phase — detection, not enforcement. Inert
+    # under CCTPU_NO_FLIGHT=1.
+    from consensusclustr_tpu.obs.flight import stall_watch
+
+    _phase_hist = lambda: tracer.metrics.histograms.get("phase_seconds")  # noqa: E731
+
+    with tracer.span("ingest"), stall_watch(
+        log, "ingest", hist=_phase_hist(), floor_s=cfg.stall_floor_s
+    ):
         ing = _ingest(counts, cfg, norm_counts=norm_counts, pca=pca)
     labels, cons, pca_used, fit_capture = _level(key, ing, cfg, log, depth=cfg.depth)
     n = len(labels)
 
     if cfg.iterate and len(set(labels.tolist())) > 1 and ing.counts is not None:
-        with tracer.span("iterate"):
+        with tracer.span("iterate"), stall_watch(
+            log, "iterate", hist=_phase_hist(), floor_s=cfg.stall_floor_s
+        ):
             labels = _iterate(
                 key, ing.counts, ing.covariates, labels, cfg, log, cfg.depth
             )
 
     # --- output assembly at depth 1 (:580-632) ----------------------------
-    with tracer.span("assemble"):
+    with tracer.span("assemble"), stall_watch(
+        log, "assemble", hist=_phase_hist(), floor_s=cfg.stall_floor_s
+    ):
         dend = None
         if len(set(labels.tolist())) > 1 and cons is not None and pca_used is not None:
             if cons.jaccard_dist is not None:

@@ -205,6 +205,14 @@ class ClusterConfig:
     # dict lookup per site hit (docs/quirks.md). Sites are registered in
     # obs/schema.py::FAULT_SITES; tools/chaos_audit.py drives the presets.
     fault_inject: Optional[str] = None
+    # Stall watchdog (obs/flight.py, ISSUE 14): minimum per-phase deadline
+    # in seconds before the watchdog calls a phase wedged. None resolves
+    # CCTPU_STALL_FLOOR_S (default 120 s). Deadlines self-tune upward from
+    # the live phase_seconds / serve_latency_seconds histograms (p99 x
+    # CCTPU_STALL_FACTOR once they hold enough samples), so the floor only
+    # matters cold. The watchdog itself rides the flight-recorder kill
+    # switch: CCTPU_NO_FLIGHT=1 disarms both.
+    stall_floor_s: Optional[float] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -277,6 +285,10 @@ class ClusterConfig:
             from consensusclustr_tpu.resilience.inject import parse_fault_spec
 
             parse_fault_spec(self.fault_inject)
+        if self.stall_floor_s is not None and float(self.stall_floor_s) <= 0:
+            raise ValueError(
+                f"stall_floor_s must be > 0; got {self.stall_floor_s}"
+            )
         if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
             raise ValueError(
                 f"resource_sample_ms must be >= 0 (0 = off); got "

@@ -452,11 +452,24 @@ def run_bootstraps(
         if log:
             log.event("boots", done=e, total=cfg.nboots)
 
+    # Stall watchdog over the boot loop (obs/flight.py, ISSUE 14): the
+    # deadline self-tunes from the boot_chunk_seconds histogram once it has
+    # samples (p99 x factor per chunk), the cfg/env floor covers the cold
+    # first chunk, and tick() re-arms per iteration — a wedged dispatch
+    # gets a stall_detected event + all-thread stack dump instead of a
+    # silent hang. Inert (one env check) under CCTPU_NO_FLIGHT=1.
+    from consensusclustr_tpu.obs.flight import stall_watch
+
     with maybe_span(
         log, "boots", nboots=cfg.nboots, chunk=chunk, pipeline_depth=depth
-    ) as bsp:
+    ) as bsp, stall_watch(
+        log, "boot_chunk",
+        hist=mets.histograms.get("boot_chunk_seconds"),
+        floor_s=cfg.stall_floor_s,
+    ) as watch:
         try:
             for s in range(0, cfg.nboots, chunk):
+                watch.tick()
                 e = min(s + chunk, cfg.nboots)
                 if ckpt is not None:
                     cached = _load_chunk(s, e - s)

@@ -50,7 +50,38 @@ per root span) and stamped into ``RunRecord.work_ledger`` (schema v7).
 Same seeded workload ⇒ same ledger on any host — ``tools/bench_diff.py
 --gate work`` gates it exactly while wall gates are noise-aware, and
 ``tools/perf_history.py`` renders the committed BENCH_*.json trajectory.
+
+The failure layer (ISSUE 14 tentpole, ``obs/flight.py`` + ``obs/alerts.py``)
+observes the system *while it is failing*: ``FlightRecorder`` keeps bounded
+rings (events, spans, metric deltas, log tail) always on and dumps a
+schema-versioned ``postmortem.json`` with all-thread stacks on unhandled
+exception, SIGTERM/SIGINT, ``_fail_all``, and retry exhaustion
+(``tools/postmortem.py`` renders/diffs dumps); ``StallWatchdog`` /
+``stall_watch`` arm per-phase/per-batch deadlines from the live latency
+histograms and fire ``stall_detected`` + a stack dump on a live wedge; and
+``AlertEngine`` evaluates declarative SLO rules (p99 bound, rejection rate,
+burn rate, counter monotonicity — ``schema.ALERT_RULES``) into
+``alert_raised``/``alert_cleared`` events, the ``alerts_active`` gauge, and
+the ``/healthz`` body. ``RunRecord`` gains ``postmortem_path``/``alerts``
+(schema v8). Kill switch: ``CCTPU_NO_FLIGHT=1``.
 """
+
+from consensusclustr_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    attach_alerts,
+    default_alert_rules,
+)
+from consensusclustr_tpu.obs.flight import (
+    FlightRecorder,
+    StallWatchdog,
+    attach_flight,
+    dump_on_failure,
+    flight_enabled,
+    global_flight,
+    global_watchdog,
+    stall_watch,
+)
 
 from consensusclustr_tpu.obs.export import (
     chrome_trace_events,
@@ -104,8 +135,11 @@ from consensusclustr_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "DEFAULT_BOUNDS",
     "EVENT_KINDS",
+    "FlightRecorder",
     "Histogram",
     "LEDGER_COUNTERS",
     "METRIC_NAMES",
@@ -116,15 +150,23 @@ __all__ = [
     "SCHEMA_VERSION",
     "SPAN_NAMES",
     "Span",
+    "StallWatchdog",
     "Tracer",
     "WorkLedger",
     "array_fingerprint",
+    "attach_alerts",
+    "attach_flight",
     "attach_ledger",
     "attach_numerics",
     "bucket_quantile",
     "chrome_trace_events",
     "config_fingerprint",
+    "default_alert_rules",
+    "dump_on_failure",
+    "flight_enabled",
+    "global_flight",
     "global_metrics",
+    "global_watchdog",
     "load_records",
     "log_bounds",
     "maybe_span",
@@ -134,6 +176,7 @@ __all__ = [
     "record_device_memory",
     "resolve_numerics",
     "resource_sampling",
+    "stall_watch",
     "tracer_of",
     "write_chrome_trace",
 ]

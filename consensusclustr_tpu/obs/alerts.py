@@ -1,0 +1,329 @@
+"""Declarative SLO alert engine over the metrics registries (ISSUE 14).
+
+The PR 7 serving histograms can answer "what is p99 right now" — but only
+if something asks. This module is the thing that asks: a small set of
+declarative :class:`AlertRule`\\ s evaluated over live
+``MetricsRegistry`` instances, turning the SLO signals into level-triggered
+alerts a router can act on:
+
+  * ``p99_bound``       — a histogram's p99 estimate above a bound;
+  * ``rate``            — windowed bad/(bad+good) fraction above a
+    threshold (the rejection-rate rule: rejected vs served requests);
+  * ``burn_rate``       — the same windowed bad fraction expressed as a
+    multiple of the allowed error budget (classic SLO burn-rate: budget
+    0.01 burning at 10x means the monthly budget is gone in 3 days);
+  * ``counter_increase`` — monotonicity watch: the counter moved within
+    the window (``retries_exhausted``, ``aot_fallbacks`` — any increase is
+    news).
+
+Rule *names* are registered in ``obs.schema.ALERT_RULES`` (the ``*_ALERT``
+literals below, validated both ways by tools/check_obs_schema.py).
+Transitions emit ``alert_raised`` / ``alert_cleared`` events and maintain
+the ``alerts_active`` gauge + ``alerts_raised`` counter; the engine's
+``summary()`` block lands in ``RunRecord.alerts`` (schema v8), in every
+bench rung, in each ``tools/loadgen.py --ladder`` step, and — via
+``AssignmentService.health()`` — in ``/healthz``, which is the ROADMAP O3
+per-replica drain signal.
+
+Evaluation is pull-based and cheap (dict deltas over a throttled sample
+ring): the serving loop evaluates once per micro-batch, ``health()`` on
+every scrape, and batch runs once at record time. Like every obs layer,
+evaluation never raises into the traced work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from consensusclustr_tpu.obs.metrics import MetricsRegistry, global_metrics
+from consensusclustr_tpu.obs.tracer import Tracer
+
+# Rule names. Each ``*_ALERT`` literal is validated against
+# obs.schema.ALERT_RULES by tools/check_obs_schema.py, both directions — a
+# renamed rule is a test failure, not a dashboard scraping a dead name.
+P99_ALERT = "serve_p99_high"
+REJECTION_ALERT = "serve_rejection_rate_high"
+BURN_ALERT = "slo_burn_rate_high"
+EXHAUSTED_ALERT = "retries_exhausted_rising"
+AOT_ALERT = "aot_fallbacks_rising"
+
+_RULE_KINDS = ("p99_bound", "rate", "burn_rate", "counter_increase")
+
+# Histogram-count pseudo-counter prefix: ``hist:serve_latency_seconds`` in a
+# rule's ``good``/``bad`` reads that histogram's observation count — served
+# requests are counted by the latency histogram, not a dedicated counter.
+_HIST_PREFIX = "hist:"
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule; which params matter depends on ``kind``."""
+
+    name: str
+    kind: str
+    hist: str = ""           # p99_bound: histogram name
+    bound_s: float = 0.0     # p99_bound: firing bound (seconds)
+    min_count: int = 20      # p99_bound: observations before p99 is trusted
+    bad: str = ""            # rate/burn_rate: numerator counter
+    good: str = ""           # rate/burn_rate: denominator companion
+    threshold: float = 0.05  # rate: firing fraction
+    budget: float = 0.01     # burn_rate: allowed bad fraction (the budget)
+    factor: float = 10.0     # burn_rate: burn multiple that fires
+    counter: str = ""        # counter_increase: the watched counter
+    window_s: float = 60.0   # rolling window for the windowed kinds
+    min_events: int = 20     # rate/burn_rate: min bad+good window events
+
+    def __post_init__(self) -> None:
+        if self.kind not in _RULE_KINDS:
+            raise ValueError(
+                f"alert rule kind must be one of {_RULE_KINDS}; got "
+                f"{self.kind!r}"
+            )
+        if not self.name:
+            raise ValueError("alert rule name must be non-empty")
+        if self.window_s <= 0:
+            raise ValueError(f"window_s must be > 0; got {self.window_s}")
+
+
+def default_alert_rules() -> Tuple[AlertRule, ...]:
+    """The stock rule set. Env overrides for the two tunable bounds:
+    ``CCTPU_ALERT_P99_S`` (default 30 s — far above any healthy micro-batch,
+    so it only fires on a genuinely sick replica) and
+    ``CCTPU_ALERT_REJECT_RATE`` (default 0.05 — a service shedding >5% of
+    its traffic should be drained)."""
+    p99_s = float(os.environ.get("CCTPU_ALERT_P99_S", "") or 30.0)
+    reject = float(os.environ.get("CCTPU_ALERT_REJECT_RATE", "") or 0.05)
+    served = _HIST_PREFIX + "serve_latency_seconds"
+    return (
+        AlertRule(
+            P99_ALERT, "p99_bound",
+            hist="serve_latency_seconds", bound_s=p99_s, min_count=50,
+        ),
+        AlertRule(
+            REJECTION_ALERT, "rate",
+            bad="serve_rejections", good=served, threshold=reject,
+            window_s=60.0, min_events=20,
+        ),
+        AlertRule(
+            BURN_ALERT, "burn_rate",
+            bad="serve_rejections", good=served, budget=0.01, factor=10.0,
+            window_s=300.0, min_events=50,
+        ),
+        AlertRule(
+            EXHAUSTED_ALERT, "counter_increase",
+            counter="retries_exhausted", window_s=300.0,
+        ),
+        AlertRule(
+            AOT_ALERT, "counter_increase",
+            counter="aot_fallbacks", window_s=300.0,
+        ),
+    )
+
+
+class AlertEngine:
+    """Level-triggered rule evaluation with raise/clear transitions.
+
+    ``registries`` are read live (counters + histogram counts fold into one
+    total per name); the tracer (when given) receives the transition events
+    and owns the emission registry for the ``alerts_active`` gauge /
+    ``alerts_raised`` counter. The sample ring is throttled (at most ~512
+    samples per longest window) so a per-batch evaluation cadence stays
+    O(1) memory however long the service lives.
+    """
+
+    def __init__(
+        self,
+        registries: Sequence[MetricsRegistry],
+        rules: Optional[Sequence[AlertRule]] = None,
+        tracer: Optional[Tracer] = None,
+    ) -> None:
+        self._regs: Tuple[MetricsRegistry, ...] = tuple(registries)
+        self.rules: Tuple[AlertRule, ...] = tuple(
+            rules if rules is not None else default_alert_rules()
+        )
+        self._tracer = tracer
+        self.active: Dict[str, dict] = {}
+        self.raised_total = 0
+        self.cleared_total = 0
+        self.last_alert: Optional[dict] = None
+        windowed = [
+            r.window_s for r in self.rules if r.kind != "p99_bound"
+        ]
+        self._max_window_s = max(windowed) if windowed else 60.0
+        self._sample_gap_s = min(2.0, max(0.05, self._max_window_s / 512.0))
+        # (t, {name: total}) ring; the head sample sits just outside the
+        # longest window so every rule always has a delta base
+        self._samples: "deque[Tuple[float, Dict[str, float]]]" = deque()
+
+    # -- reading -------------------------------------------------------------
+
+    def _totals(self) -> Dict[str, float]:
+        vals: Dict[str, float] = {}
+        for reg in self._regs:
+            for name, c in list(reg.counters.items()):
+                vals[name] = vals.get(name, 0.0) + c.value
+            for name, h in list(reg.histograms.items()):
+                key = _HIST_PREFIX + name
+                vals[key] = vals.get(key, 0.0) + h.count
+        return vals
+
+    def _emit_metrics(self) -> MetricsRegistry:
+        if self._tracer is not None:
+            return self._tracer.metrics
+        return self._regs[0] if self._regs else global_metrics()
+
+    def _window_base(
+        self, t: float, window_s: float
+    ) -> Optional[Dict[str, float]]:
+        """The newest sample at or outside ``t - window_s`` (else the oldest
+        available — a partial window while the service is young)."""
+        base: Optional[Dict[str, float]] = None
+        for ts, vals in self._samples:
+            if ts <= t - window_s:
+                base = vals
+            else:
+                break
+        if base is None and self._samples:
+            base = self._samples[0][1]
+        return base
+
+    def _p99(self, rule: AlertRule) -> Optional[float]:
+        best: Optional[float] = None
+        for reg in self._regs:
+            h = reg.histograms.get(rule.hist)
+            if h is None or h.count < rule.min_count:
+                continue
+            try:
+                q = h.quantile(0.99)
+            except Exception:
+                q = None
+            if q is not None:
+                best = q if best is None else max(best, q)
+        return best
+
+    def _eval_rule(
+        self, rule: AlertRule, t: float, totals: Dict[str, float]
+    ) -> Tuple[bool, Optional[float], float]:
+        """(fired, observed value, firing threshold) for one rule."""
+        if rule.kind == "p99_bound":
+            p99 = self._p99(rule)
+            return (p99 is not None and p99 > rule.bound_s, p99, rule.bound_s)
+        base = self._window_base(t, rule.window_s) or {}
+        if rule.kind == "counter_increase":
+            delta = totals.get(rule.counter, 0.0) - base.get(rule.counter, 0.0)
+            return (delta > 0, delta, 0.0)
+        bad = totals.get(rule.bad, 0.0) - base.get(rule.bad, 0.0)
+        good = totals.get(rule.good, 0.0) - base.get(rule.good, 0.0)
+        events = bad + good
+        if events < rule.min_events or events <= 0:
+            return (False, None, rule.threshold)
+        frac = bad / events
+        if rule.kind == "rate":
+            return (frac > rule.threshold, round(frac, 6), rule.threshold)
+        burn = frac / rule.budget if rule.budget > 0 else float("inf")
+        return (burn >= rule.factor, round(burn, 4), rule.factor)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """One evaluation pass: sample the registries, run every rule, fire
+        raise/clear transitions, refresh the gauge. Returns the active-alert
+        map. Never raises."""
+        try:
+            return self._evaluate(now)
+        except Exception:
+            return dict(self.active)
+
+    def _evaluate(self, now: Optional[float]) -> Dict[str, dict]:
+        t = time.monotonic() if now is None else float(now)
+        totals = self._totals()
+        if (
+            not self._samples
+            or t - self._samples[-1][0] >= self._sample_gap_s
+        ):
+            self._samples.append((t, totals))
+            while (
+                len(self._samples) >= 2
+                and self._samples[1][0] <= t - self._max_window_s
+            ):
+                self._samples.popleft()
+        mets = self._emit_metrics()
+        for rule in self.rules:
+            fired, value, threshold = self._eval_rule(rule, t, totals)
+            was = rule.name in self.active
+            if fired:
+                info = {
+                    "name": rule.name,
+                    "kind": rule.kind,
+                    "value": value,
+                    "threshold": threshold,
+                }
+                if was:
+                    info["since_s"] = self.active[rule.name].get(
+                        "since_s", round(t, 4)
+                    )
+                else:
+                    info["since_s"] = round(t, 4)
+                    self.raised_total += 1
+                    mets.counter("alerts_raised").inc()
+                    self.last_alert = dict(info)
+                    if self._tracer is not None:
+                        self._tracer.event(
+                            "alert_raised", name=rule.name, value=value,
+                            threshold=threshold,
+                        )
+                self.active[rule.name] = info
+            elif was:
+                del self.active[rule.name]
+                self.cleared_total += 1
+                if self._tracer is not None:
+                    self._tracer.event(
+                        "alert_cleared", name=rule.name, value=value,
+                    )
+        mets.gauge("alerts_active").set(len(self.active))
+        return dict(self.active)
+
+    def summary(self) -> dict:
+        """JSON-able block for ``RunRecord.alerts`` / bench rungs / ladder
+        steps: a final evaluation plus the transition totals."""
+        self.evaluate()
+        return {
+            "active": {k: dict(v) for k, v in sorted(self.active.items())},
+            "raised_total": self.raised_total,
+            "cleared_total": self.cleared_total,
+            "last_alert": dict(self.last_alert) if self.last_alert else None,
+            "rules": sorted(r.name for r in self.rules),
+        }
+
+
+def attach_alerts(
+    tracer: Optional[Tracer],
+    registries: Optional[Sequence[MetricsRegistry]] = None,
+    rules: Optional[Sequence[AlertRule]] = None,
+) -> Optional[AlertEngine]:
+    """Hang an AlertEngine off ``tracer`` (idempotent — an attached engine
+    is returned as-is) reading the tracer-local + process-global registries
+    by default. ``RunRecord.from_tracer`` harvests
+    ``tracer.alert_engine.summary()`` into the record's ``alerts`` block.
+    None-safe for tracer-less callers."""
+    if tracer is None:
+        return None
+    existing = getattr(tracer, "alert_engine", None)
+    if isinstance(existing, AlertEngine):
+        return existing
+    regs: Sequence[MetricsRegistry] = (
+        registries
+        if registries is not None
+        else (tracer.metrics, global_metrics())
+    )
+    engine = AlertEngine(regs, rules=rules, tracer=tracer)
+    tracer.alert_engine = engine  # type: ignore[attr-defined]
+    return engine
+
+
+def alert_names(rules: Sequence[AlertRule]) -> List[str]:
+    return sorted(r.name for r in rules)

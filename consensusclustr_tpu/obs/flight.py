@@ -1,0 +1,639 @@
+"""Black-box flight recorder + stall watchdog (ISSUE 14 tentpole).
+
+Every obs layer before this one explains a run *after* it finishes — run
+records, Perfetto traces, the work ledger. Nothing captured state at the
+moment a process died (a SIGTERM'd host, a worker past its restart limit),
+and nothing could see a live wedge: the serving tunnel kills calls that
+stall past ~2 min (consensus/pipeline.py) and the process never learns why.
+Two pieces close that gap:
+
+  * :class:`FlightRecorder` — bounded ring buffers of recent closed spans,
+    events, per-root-phase metric deltas and the last-N log lines, fed by
+    the same tracer hooks the ledger/sampler use. **Always on** (the one
+    obs layer that is, docs/quirks.md: it only ever *writes* on failure —
+    the steady-state cost is a few deque appends per span/event). On
+    unhandled exception (``sys.excepthook`` chain), fatal signal
+    (SIGTERM/SIGINT handler chain), serving give-up
+    (``AssignmentService._fail_all``), retry exhaustion
+    (resilience/retry.py) or a watchdog stall it dumps everything — plus
+    all-thread stack traces and a live merged metrics snapshot — as one
+    schema-versioned ``postmortem.json`` (rendered/diffed by
+    tools/postmortem.py, path recorded in ``RunRecord.postmortem_path``).
+    ``CCTPU_NO_FLIGHT=1`` is the kill switch for the whole layer.
+
+  * :class:`StallWatchdog` — one lazy daemon thread arming per-phase /
+    per-chunk / per-batch deadlines (derived from the ``phase_seconds`` /
+    ``boot_chunk_seconds`` / ``serve_latency_seconds`` histograms via
+    ``p99 x CCTPU_STALL_FACTOR``, floored by ``CCTPU_STALL_FLOOR_S`` /
+    ``ClusterConfig.stall_floor_s`` and the per-site floors the call sites
+    pass). Expiry emits a ``stall_detected`` event + ``stalls_detected``
+    counter, dumps a ``stall`` post-mortem (with the wedged thread's stack
+    in it), and runs an optional ``escalate`` callback so a caller can hand
+    the wedge to the PR 10 supervision path. Detection only: the watchdog
+    never interrupts the watched work.
+
+Dump paths resolve ``CCTPU_POSTMORTEM_PATH`` (exact file) >
+``CCTPU_POSTMORTEM_DIR`` (one numbered file per dump) > a per-pid file in
+the system temp dir — the default never litters a working directory, and
+the chosen path always lands in the ``postmortem_dump`` event and
+``RunRecord.postmortem_path``. Everything here is exception-swallowed:
+observability must never fail the traced work, least of all while it is
+already failing.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import logging
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from consensusclustr_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    global_metrics,
+)
+from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+from consensusclustr_tpu.obs.tracer import Tracer, tracer_of
+
+# Dump-reason vocabulary. Each ``*_FLIGHT`` literal is validated against
+# obs.schema.FLIGHT_EVENT_KINDS by tools/check_obs_schema.py, both
+# directions — a renamed reason is a test failure, not a dump
+# tools/postmortem.py can't classify.
+EXCEPTION_FLIGHT = "exception"
+SIGNAL_FLIGHT = "signal"
+FAIL_ALL_FLIGHT = "fail_all"
+RETRIES_FLIGHT = "retries_exhausted"
+STALL_FLIGHT = "stall"
+MANUAL_FLIGHT = "manual"
+
+# Version of the dump layout itself (inside the obs SCHEMA_VERSION stamp):
+# bump when the postmortem.json key set changes shape.
+FLIGHT_DUMP_VERSION = 1
+
+# Ring capacities: recent-history tails, not archives — the RunRecord keeps
+# the full streams. ~256 events/spans is minutes of pipeline history and
+# every event of a failing batch; 64 metric deltas covers any realistic
+# phase count; 100 log lines matches a terminal scrollback.
+DEFAULT_RING_CAPACITY = 256
+DEFAULT_SNAPSHOT_CAPACITY = 64
+DEFAULT_LOG_LINES = 100
+
+DEFAULT_STALL_FLOOR_S = 120.0   # the serving tunnel kills at ~2 min
+DEFAULT_STALL_FACTOR = 8.0      # deadline = max(floor, p99 * factor)
+_MIN_HIST_COUNT = 8             # observations before p99 is trusted
+_STACK_FRAME_CAP = 50           # per-thread frames serialized in a dump
+
+_LOG_RING_MARK = "_cctpu_flight_ring"
+
+
+def flight_enabled() -> bool:
+    """The layer's kill switch: on unless ``CCTPU_NO_FLIGHT`` is set (the
+    recorder only writes on failure, so on-by-default costs ring appends)."""
+    return not os.environ.get("CCTPU_NO_FLIGHT", "").strip()
+
+
+def resolve_postmortem_path(seq: int = 0) -> str:
+    """Where the next dump goes: ``CCTPU_POSTMORTEM_PATH`` (exact file,
+    overwritten — last dump wins) > ``CCTPU_POSTMORTEM_DIR`` (numbered per
+    dump) > one per-pid file in the temp dir (overwritten)."""
+    path = os.environ.get("CCTPU_POSTMORTEM_PATH", "").strip()
+    if path:
+        return path
+    d = os.environ.get("CCTPU_POSTMORTEM_DIR", "").strip()
+    if d:
+        return os.path.join(d, f"postmortem-{os.getpid()}-{seq}.json")
+    return os.path.join(
+        tempfile.gettempdir(), f"cctpu-postmortem-{os.getpid()}.json"
+    )
+
+
+def thread_stacks() -> Dict[str, List[str]]:
+    """All live threads' current stacks, formatted. The core of every dump:
+    at SIGTERM/stall time this is the only record of *where* each thread
+    was (frames capped so a deep recursion can't bloat the dump)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        lines = traceback.format_stack(frame)[-_STACK_FRAME_CAP:]
+        out[f"{names.get(ident, '?')}:{ident}"] = [
+            ln.rstrip("\n") for ln in lines
+        ]
+    return out
+
+
+class _RingHandler(logging.Handler):
+    """logging.Handler feeding the recorder's last-N-log-lines ring."""
+
+    def __init__(self, ring: "collections.deque") -> None:
+        super().__init__()
+        self._ring = ring
+        self.setFormatter(
+            logging.Formatter("%(levelname)s %(name)s: %(message)s")
+        )
+        setattr(self, _LOG_RING_MARK, True)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._ring.append(self.format(record))
+        except Exception:
+            pass
+
+
+class FlightRecorder:
+    """Bounded rings of recent observability state + the dump path.
+
+    Feeding is push-based: :func:`attach_flight` wires a tracer's event
+    stream and span-close hook into the rings (and pushes a per-counter
+    delta snapshot at every root-span close), and the constructor hangs a
+    ring handler off the package logger. All rings are ``deque(maxlen=...)``
+    — steady-state cost is appends, memory is bounded forever.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_RING_CAPACITY,
+        snapshot_capacity: int = DEFAULT_SNAPSHOT_CAPACITY,
+        log_lines: int = DEFAULT_LOG_LINES,
+        attach_log_handler: bool = True,
+    ) -> None:
+        self.events: "collections.deque" = collections.deque(maxlen=capacity)
+        self.spans: "collections.deque" = collections.deque(maxlen=capacity)
+        self.snapshots: "collections.deque" = collections.deque(
+            maxlen=snapshot_capacity
+        )
+        self.log_lines: "collections.deque" = collections.deque(
+            maxlen=log_lines
+        )
+        self.epoch = time.monotonic()
+        self.last_dump_path: Optional[str] = None
+        self.last_dump_reason: Optional[str] = None
+        self.dumps = 0
+        self._tracers: List[Tracer] = []
+        self._last_counters: Dict[str, float] = {}
+        self._dump_lock = threading.Lock()
+        if attach_log_handler:
+            try:
+                from consensusclustr_tpu.utils.log import get_logger
+
+                logger = get_logger()
+                if not any(
+                    getattr(h, _LOG_RING_MARK, False) for h in logger.handlers
+                ):
+                    logger.addHandler(_RingHandler(self.log_lines))
+            except Exception:
+                pass
+
+    # -- feeding -------------------------------------------------------------
+
+    def note_event(self, rec: dict) -> None:
+        self.events.append(rec)
+
+    def note_span(self, span: Any) -> None:
+        rec = {
+            "name": getattr(span, "name", "?"),
+            "t0": getattr(span, "t0", None),
+            "seconds": getattr(span, "seconds", None),
+        }
+        if not getattr(span, "ok", True):
+            rec["ok"] = False
+            rec["error"] = getattr(span, "error", None)
+        self.spans.append(rec)
+
+    def _counter_totals(self) -> Dict[str, float]:
+        vals: Dict[str, float] = {}
+        for reg in self._registries():
+            for name, c in list(reg.counters.items()):
+                vals[name] = vals.get(name, 0.0) + c.value
+        return vals
+
+    def note_phase_delta(self, phase: str) -> None:
+        """Push one metric-delta snapshot (counter movement since the last
+        push, attributed to ``phase``) — called at root-span close."""
+        now = self._counter_totals()
+        delta = {
+            k: v - self._last_counters.get(k, 0.0)
+            for k, v in now.items()
+            if v != self._last_counters.get(k, 0.0)
+        }
+        self._last_counters = now
+        self.snapshots.append({
+            "t": round(time.monotonic() - self.epoch, 4),
+            "phase": phase,
+            "counters": delta,
+        })
+
+    def track(self, tracer: Tracer) -> None:
+        """Merge ``tracer``'s registry into every future dump's metrics
+        snapshot (attach_flight calls this; idempotent)."""
+        if tracer is not None and not any(
+            tracer is t for t in self._tracers
+        ):
+            self._tracers.append(tracer)
+
+    def _registries(self) -> List[MetricsRegistry]:
+        return [global_metrics()] + [t.metrics for t in self._tracers]
+
+    # -- dumping -------------------------------------------------------------
+
+    def dump(
+        self,
+        reason: str,
+        detail: Optional[dict] = None,
+        path: Optional[str] = None,
+    ) -> Optional[str]:
+        """Write the black box: rings + all-thread stacks + a live merged
+        metrics snapshot, atomically (tmp + replace), as one JSON object.
+        Returns the path, or None on any failure — a dying process must
+        never die harder because its post-mortem couldn't be written."""
+        try:
+            with self._dump_lock:
+                path = path or resolve_postmortem_path(self.dumps)
+                reg = MetricsRegistry()
+                for r in self._registries():
+                    reg.merge(r)
+                payload = {
+                    "schema": SCHEMA_VERSION,
+                    "flight_dump_version": FLIGHT_DUMP_VERSION,
+                    "reason": reason,
+                    "detail": dict(detail or {}),
+                    "pid": os.getpid(),
+                    "time_unix": time.time(),
+                    "uptime_s": round(time.monotonic() - self.epoch, 4),
+                    "dump_seq": self.dumps,
+                    "threads": thread_stacks(),
+                    "events": list(self.events),
+                    "spans": list(self.spans),
+                    "metric_deltas": list(self.snapshots),
+                    "log_lines": list(self.log_lines),
+                    "metrics": reg.snapshot(),
+                }
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, default=str)
+                os.replace(tmp, path)
+                self.last_dump_path = path
+                self.last_dump_reason = reason
+                self.dumps += 1
+            global_metrics().counter("postmortem_dumps").inc()
+            for tr in self._tracers:
+                try:
+                    tr.event("postmortem_dump", reason=reason, path=path)
+                except Exception:
+                    pass
+            try:
+                from consensusclustr_tpu.utils.log import get_logger
+
+                get_logger().warning(
+                    "flight recorder: %s post-mortem written to %s",
+                    reason, path,
+                )
+            except Exception:
+                pass
+            return path
+        except Exception:
+            return None
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+_HOOKS_INSTALLED = False
+_PREV_EXCEPTHOOK: Optional[Callable] = None
+_PREV_SIGNAL: Dict[int, Any] = {}
+
+
+def global_flight() -> Optional[FlightRecorder]:
+    """The process-wide recorder (created + crash-hooks installed on first
+    use); None when ``CCTPU_NO_FLIGHT`` disarms the layer."""
+    global _RECORDER
+    if not flight_enabled():
+        return None
+    if _RECORDER is None:
+        with _RECORDER_LOCK:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder()
+                _install_crash_hooks(_RECORDER)
+    return _RECORDER
+
+
+def _install_crash_hooks(recorder: FlightRecorder) -> None:
+    """Chain sys.excepthook and the SIGTERM/SIGINT handlers: dump first,
+    then hand control to whatever was installed before us. Signal install
+    is main-thread-only by CPython contract — elsewhere the excepthook and
+    explicit dump triggers still cover the layer."""
+    global _HOOKS_INSTALLED, _PREV_EXCEPTHOOK
+    if _HOOKS_INSTALLED:
+        return
+    _HOOKS_INSTALLED = True
+
+    _PREV_EXCEPTHOOK = sys.excepthook
+
+    def _excepthook(tp, val, tb):
+        recorder.dump(
+            EXCEPTION_FLIGHT,
+            {"error": tp.__name__, "message": str(val)[:500]},
+        )
+        if _PREV_EXCEPTHOOK is not None:
+            _PREV_EXCEPTHOOK(tp, val, tb)
+
+    sys.excepthook = _excepthook
+
+    def _on_signal(signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except Exception:
+            name = str(signum)
+        recorder.dump(SIGNAL_FLIGHT, {"signal": name})
+        prev = _PREV_SIGNAL.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            # default disposition: restore it and re-deliver, so the
+            # process still dies with the signal's own exit status
+            try:
+                signal.signal(
+                    signum, prev if prev is not None else signal.SIG_DFL
+                )
+                os.kill(os.getpid(), signum)
+            except Exception:
+                raise SystemExit(128 + signum)
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            _PREV_SIGNAL[signum] = signal.signal(signum, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread / unsupported platform
+
+
+def attach_flight(tracer: Optional[Tracer]) -> Optional[FlightRecorder]:
+    """Wire ``tracer`` into the process recorder (idempotent): its events
+    and closed spans feed the rings, every root-span close pushes a metric
+    -delta snapshot, and its registry joins the dump-time snapshot merge.
+    Exposes the recorder as ``tracer.flight`` (where
+    ``RunRecord.from_tracer`` picks up ``postmortem_path``). None-safe and
+    None when the layer is disarmed."""
+    recorder = global_flight()
+    if tracer is None or recorder is None:
+        return recorder
+    if getattr(tracer, "flight", None) is recorder:
+        return recorder
+    recorder.track(tracer)
+    tracer.flight = recorder  # type: ignore[attr-defined]
+
+    orig_event = tracer.event
+
+    def _event(kind: str, **fields: Any) -> None:
+        orig_event(kind, **fields)
+        try:
+            recorder.note_event({
+                "t": round(time.monotonic() - tracer.epoch, 4),
+                "kind": kind, **fields,
+            })
+        except Exception:
+            pass
+
+    tracer.event = _event  # type: ignore[method-assign]
+
+    def _on_span_close(span: Any) -> None:
+        try:
+            recorder.note_span(span)
+            if any(span is r for r in tracer.roots):
+                recorder.note_phase_delta(span.name)
+        except Exception:
+            pass
+
+    tracer.add_span_close_hook(_on_span_close)
+    return recorder
+
+
+def dump_on_failure(reason: str, log: Any = None, **detail: Any) -> Optional[str]:
+    """Fire-and-forget dump trigger for failure paths (retry exhaustion,
+    serving give-up): dumps iff the layer is armed, never raises. The
+    tracer behind ``log`` (when given) is tracked first so its metrics land
+    in the snapshot."""
+    try:
+        recorder = global_flight()
+        if recorder is None:
+            return None
+        tr = tracer_of(log)
+        if tr is not None:
+            recorder.track(tr)
+        return recorder.dump(reason, dict(detail))
+    except Exception:
+        return None
+
+
+# -- stall watchdog ----------------------------------------------------------
+
+
+def resolve_stall_floor_s(requested: Optional[float] = None) -> float:
+    """Explicit arg / ClusterConfig.stall_floor_s > $CCTPU_STALL_FLOOR_S >
+    120 s (the serving tunnel's own kill horizon)."""
+    if requested is None:
+        env = os.environ.get("CCTPU_STALL_FLOOR_S", "").strip()
+        requested = float(env) if env else DEFAULT_STALL_FLOOR_S
+    v = float(requested)
+    if v <= 0:
+        raise ValueError(f"stall floor must be > 0 seconds; got {v}")
+    return v
+
+
+def stall_deadline_s(
+    hist: Optional[Histogram] = None,
+    floor_s: Optional[float] = None,
+    factor: Optional[float] = None,
+) -> float:
+    """A watch deadline: ``max(floor, p99(hist) * factor)``. The histogram
+    term adapts to the workload once enough observations exist (a chunk
+    that normally takes 70 s gets ~9 min, not the floor); the floor keeps
+    cold starts from arming hair-trigger deadlines."""
+    floor = resolve_stall_floor_s(floor_s)
+    if factor is None:
+        env = os.environ.get("CCTPU_STALL_FACTOR", "").strip()
+        factor = float(env) if env else DEFAULT_STALL_FACTOR
+    derived = 0.0
+    if hist is not None and hist.count >= _MIN_HIST_COUNT:
+        try:
+            q = hist.quantile(0.99)
+            if q is not None:
+                derived = float(q) * float(factor)
+        except Exception:
+            derived = 0.0
+    return max(floor, derived)
+
+
+class _Watch:
+    """One armed deadline; ``tick()`` re-arms it (per chunk / per batch)."""
+
+    __slots__ = ("name", "deadline_s", "tracer", "escalate", "armed_at",
+                 "fired", "closed")
+
+    def __init__(self, name, deadline_s, tracer, escalate) -> None:
+        self.name = name
+        self.deadline_s = float(deadline_s)
+        self.tracer = tracer
+        self.escalate = escalate
+        self.armed_at = time.monotonic()
+        self.fired = False
+        self.closed = False
+
+    def tick(self) -> None:
+        self.armed_at = time.monotonic()
+        self.fired = False
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class _NullWatch:
+    """Inert handle when the layer is disarmed — call sites stay branch-free."""
+
+    def tick(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class StallWatchdog:
+    """One daemon thread over all armed watches: sleeps until the earliest
+    deadline, fires each expiry exactly once per arm (a ``tick()`` re-arms).
+    Detection only — the watched work is never interrupted; firing emits
+    the ``stall_detected`` event + counter, writes a ``stall`` post-mortem
+    (the wedged thread's stack is in the all-thread dump) and runs the
+    watch's ``escalate`` callback, all exception-swallowed."""
+
+    def __init__(self, recorder: Optional[FlightRecorder] = None) -> None:
+        self._recorder = recorder
+        self._watches: List[_Watch] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(
+        self,
+        name: str,
+        deadline_s: float,
+        tracer: Optional[Tracer] = None,
+        escalate: Optional[Callable[[], None]] = None,
+    ) -> _Watch:
+        w = _Watch(name, deadline_s, tracer, escalate)
+        with self._lock:
+            self._watches.append(w)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="cctpu-stall-watchdog", daemon=True
+                )
+                self._thread.start()
+        self._wake.set()
+        return w
+
+    def _loop(self) -> None:
+        while True:
+            # clear FIRST: a watch() landing after the scan below re-wakes
+            # the sleep instead of being lost to the clear
+            self._wake.clear()
+            now = time.monotonic()
+            next_due: Optional[float] = None
+            with self._lock:
+                self._watches = [w for w in self._watches if not w.closed]
+                due = [
+                    w for w in self._watches
+                    if not w.fired and now - w.armed_at >= w.deadline_s
+                ]
+                for w in self._watches:
+                    if w.fired:
+                        continue
+                    t = w.armed_at + w.deadline_s
+                    next_due = t if next_due is None else min(next_due, t)
+            for w in due:
+                w.fired = True
+                self._fire(w, now - w.armed_at)
+            if due:
+                continue  # re-scan: firing took time, deadlines moved
+            # no armed watch: park until the next watch()/tick() wakes us
+            timeout = (
+                None if next_due is None
+                else max(0.01, next_due - time.monotonic())
+            )
+            self._wake.wait(timeout)
+
+    def _fire(self, w: _Watch, waited_s: float) -> None:
+        try:
+            mets = w.tracer.metrics if w.tracer is not None else global_metrics()
+            mets.counter("stalls_detected").inc()
+            if w.tracer is not None:
+                w.tracer.event(
+                    "stall_detected", name=w.name,
+                    deadline_s=round(w.deadline_s, 4),
+                    waited_s=round(waited_s, 4),
+                )
+            recorder = self._recorder or global_flight()
+            if recorder is not None:
+                if w.tracer is not None:
+                    recorder.track(w.tracer)
+                recorder.dump(
+                    STALL_FLIGHT,
+                    {
+                        "watch": w.name,
+                        "deadline_s": round(w.deadline_s, 4),
+                        "waited_s": round(waited_s, 4),
+                    },
+                )
+            if w.escalate is not None:
+                w.escalate()
+        except Exception:
+            pass  # the watchdog must never fail the watched work
+
+
+_WATCHDOG: Optional[StallWatchdog] = None
+_WATCHDOG_LOCK = threading.Lock()
+_NULL_WATCH = _NullWatch()
+
+
+def global_watchdog() -> StallWatchdog:
+    global _WATCHDOG
+    if _WATCHDOG is None:
+        with _WATCHDOG_LOCK:
+            if _WATCHDOG is None:
+                _WATCHDOG = StallWatchdog()
+    return _WATCHDOG
+
+
+@contextlib.contextmanager
+def stall_watch(
+    log: Any = None,
+    name: str = "work",
+    deadline_s: Optional[float] = None,
+    hist: Optional[Histogram] = None,
+    floor_s: Optional[float] = None,
+    factor: Optional[float] = None,
+    escalate: Optional[Callable[[], None]] = None,
+):
+    """Arm a deadline around a block; yields a handle whose ``tick()``
+    re-arms it (call once per chunk/batch inside a loop). Inert (yields a
+    no-op handle) when ``CCTPU_NO_FLIGHT`` disarms the layer — the off path
+    costs one env check."""
+    if not flight_enabled():
+        yield _NULL_WATCH
+        return
+    if deadline_s is None:
+        deadline_s = stall_deadline_s(hist, floor_s, factor)
+    w = global_watchdog().watch(
+        name, deadline_s, tracer=tracer_of(log), escalate=escalate
+    )
+    try:
+        yield w
+    finally:
+        w.close()

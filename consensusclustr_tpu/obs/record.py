@@ -93,6 +93,14 @@ class RunRecord:
     # runs attach the ledger unconditionally (it is one dict subtraction
     # per root span).
     work_ledger: Optional[dict] = None
+    # schema v8: path of the flight-recorder post-mortem dump, if one was
+    # written during this run (obs/flight.py). None on clean runs — the
+    # recorder only ever writes on failure — and on older records.
+    postmortem_path: Optional[str] = None
+    # schema v8: SLO alert engine summary (obs/alerts.py AlertEngine
+    # summary) — active alerts at record time, raise/clear totals, and the
+    # last alert raised. None on older records and tracer-less runs.
+    alerts: Optional[dict] = None
 
     @classmethod
     def from_tracer(
@@ -141,6 +149,17 @@ class RunRecord:
                 work_ledger = ledger.summary()
             except Exception:
                 work_ledger = None
+        flight = getattr(tracer, "flight", None)
+        postmortem_path = None
+        if flight is not None:
+            postmortem_path = getattr(flight, "last_dump_path", None)
+        engine = getattr(tracer, "alert_engine", None)
+        alerts = None
+        if engine is not None:
+            try:
+                alerts = engine.summary()
+            except Exception:
+                alerts = None
         return cls(
             schema=SCHEMA_VERSION,
             backend=backend,
@@ -153,6 +172,8 @@ class RunRecord:
             resource=resource,
             numerics=numerics,
             work_ledger=work_ledger,
+            postmortem_path=postmortem_path,
+            alerts=alerts,
         )
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -181,6 +202,10 @@ class RunRecord:
             d["numerics"] = self.numerics
         if self.work_ledger is not None:
             d["work_ledger"] = self.work_ledger
+        if self.postmortem_path is not None:
+            d["postmortem_path"] = self.postmortem_path
+        if self.alerts is not None:
+            d["alerts"] = self.alerts
         return d
 
     def to_json(self) -> str:
@@ -225,6 +250,8 @@ class RunRecord:
             resource=d.get("resource"),
             numerics=d.get("numerics"),
             work_ledger=d.get("work_ledger"),
+            postmortem_path=d.get("postmortem_path"),
+            alerts=d.get("alerts"),
         )
 
 

@@ -86,7 +86,19 @@ from __future__ import annotations
 # executable-cache counters and the ``aot_warm_start`` event are new names
 # with no change to any existing one; the RunRecord layout is untouched and
 # the bench ``warm_start`` rung is a new block (same precedent as ISSUE 9/10).
-SCHEMA_VERSION = 7
+# v8 (ISSUE 14): failure-time observability — RunRecord gained the optional
+# ``postmortem_path`` (where the obs/flight.py black-box recorder wrote its
+# last schema-versioned post-mortem dump, None when nothing failed) and
+# ``alerts`` (obs/alerts.py AlertEngine summary: active alerts, raise/clear
+# totals, last alert) fields. New names: the ``stall_detected`` /
+# ``alert_raised`` / ``alert_cleared`` / ``postmortem_dump`` events, the
+# ``stalls_detected`` / ``alerts_raised`` / ``postmortem_dumps`` counters and
+# the ``alerts_active`` gauge, plus the FLIGHT_EVENT_KINDS (dump-reason
+# vocabulary) and ALERT_RULES (declarative SLO rule names) registries below.
+# Every bench rung — including the failure payload — now carries ``alerts``
+# and ``postmortem_path`` keys, and /healthz reports ``alerts_active`` /
+# ``last_alert``. See docs/quirks.md "Observability schema v7 → v8".
+SCHEMA_VERSION = 8
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -140,6 +152,15 @@ EVENT_KINDS = frozenset({
     "aot_warm_start",        # warm-up finished its AOT pass (hits/saved/
                              # buckets attrs — hits == buckets is a fully
                              # warm cross-process start)
+    # obs/flight.py + obs/alerts.py (ISSUE 14)
+    "stall_detected",        # the watchdog saw a watch scope exceed its
+                             # deadline (name, deadline_s, waited_s attrs;
+                             # an all-thread stack dump follows)
+    "postmortem_dump",       # the flight recorder wrote a post-mortem
+                             # (reason from FLIGHT_EVENT_KINDS + path attrs)
+    "alert_raised",          # an ALERT_RULES rule transitioned to firing
+                             # (name, value, threshold attrs)
+    "alert_cleared",         # a previously firing rule recovered
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -236,6 +257,11 @@ METRIC_HELP = {
     "aot_cache_misses": "counter: AOT cache lookups with no entry (cold start — trace + serialize)",
     "aot_cache_saves": "counter: compiled serving executables serialized into the AOT cache",
     "aot_fallbacks": "counter: present-but-unloadable AOT entries that fell back to trace (loud: warns per entry)",
+    # failure-time observability (obs/flight.py + obs/alerts.py, ISSUE 14)
+    "stalls_detected": "counter: watchdog deadline expiries (a watch scope ran past its armed deadline)",
+    "postmortem_dumps": "counter: flight-recorder post-mortem dumps written (exception/signal/fail_all/retries_exhausted/stall)",
+    "alerts_raised": "counter: SLO alert rule raise transitions (obs/alerts.py AlertEngine)",
+    "alerts_active": "gauge: currently firing SLO alert rules (0 on a healthy replica — the /healthz drain signal)",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -343,4 +369,35 @@ CONSENSUS_SPAN_ATTRS = frozenset({
 SNN_IMPLS = frozenset({
     "jax",
     "pallas",
+})
+
+# Flight-recorder dump reasons (ISSUE 14): why obs/flight.py wrote a
+# post-mortem. Stamped as the dump's ``reason`` field and on the
+# ``postmortem_dump`` event, so tools/postmortem.py can render/diff dumps by
+# failure class. tools/check_obs_schema.py validates the ``*_FLIGHT``
+# literals in obs/flight.py against this set, both directions — a renamed
+# reason is a test failure, not a dump a post-mortem tool can't classify.
+FLIGHT_EVENT_KINDS = frozenset({
+    "exception",           # unhandled exception (sys.excepthook chain)
+    "signal",              # fatal signal (SIGTERM/SIGINT handler chain)
+    "fail_all",            # serving gave up: AssignmentService._fail_all
+    "retries_exhausted",   # a fault site surfaced its original exception
+    "stall",               # the watchdog saw a deadline expire
+    "manual",              # an explicit dump() call (tests, operators)
+})
+
+# Declarative SLO alert rules (ISSUE 14): the names obs/alerts.py evaluates
+# over the metrics registries and fires as ``alert_raised``/``alert_cleared``
+# events + the ``alerts_active`` gauge (surfaced in /healthz so a router can
+# drain a sick replica). tools/check_obs_schema.py validates the ``*_ALERT``
+# literals in obs/alerts.py against this set, both directions, and that
+# every alert literal obs/flight.py, serve/service.py and the bench/audit
+# tools name is registered — a renamed rule is a test failure, not a
+# dashboard silently scraping a dead alert name.
+ALERT_RULES = frozenset({
+    "serve_p99_high",           # serve_latency_seconds p99 above its bound
+    "serve_rejection_rate_high",  # windowed rejected/(rejected+served) rate
+    "slo_burn_rate_high",       # error-budget burn multiple over the window
+    "retries_exhausted_rising", # retries_exhausted moved within the window
+    "aot_fallbacks_rising",     # aot_fallbacks moved within the window
 })

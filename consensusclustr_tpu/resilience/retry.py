@@ -151,4 +151,19 @@ def retry_call(
             error=type(last).__name__,
         )
     assert last is not None
+    # Black-box dump before the raise escapes: retry exhaustion is one of
+    # the four flight-recorder triggers (obs/flight.py). Lazy import and
+    # never-raise — a broken recorder must not mask the real error.
+    try:
+        from consensusclustr_tpu.obs.flight import (
+            RETRIES_FLIGHT,
+            dump_on_failure,
+        )
+
+        dump_on_failure(
+            RETRIES_FLIGHT, log=log, site=site, attempts=attempt,
+            error=type(last).__name__,
+        )
+    except Exception:
+        pass
     raise last

@@ -331,6 +331,18 @@ class AssignmentService:
         self.snap_eps = float(snap_eps)
         self.tracer = tracer if tracer is not None else Tracer()
         self.metrics = self.tracer.metrics
+        # Failure observability (ISSUE 14): flight recorder rings on the
+        # service tracer (dumps on _fail_all / crash), the SLO alert engine
+        # evaluated once per micro-batch and on every health() scrape —
+        # /healthz carries alerts_active + last_alert so a router can drain
+        # a sick replica (ROADMAP O3). CCTPU_NO_FLIGHT=1 disarms the
+        # recorder + watchdog; the alert engine is passive arithmetic.
+        from consensusclustr_tpu.obs.alerts import attach_alerts
+        from consensusclustr_tpu.obs.flight import attach_flight
+
+        attach_flight(self.tracer)
+        self._alerts = attach_alerts(self.tracer)
+        self._stall_floor_s = getattr(cfg, "stall_floor_s", None)
         self._tracker = CompileTracker()
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._thread: Optional[threading.Thread] = None
@@ -614,7 +626,19 @@ class AssignmentService:
 
     def _fail_all(self, err: BaseException) -> None:
         """Give-up path: close intake and fail every pending/queued future
-        rather than strand callers on a dead worker."""
+        rather than strand callers on a dead worker. Dumps the flight
+        recorder first — this is the serving layer's black-box moment: the
+        dump's tail events carry the worker-restart trail that led here."""
+        from consensusclustr_tpu.obs.flight import (
+            FAIL_ALL_FLIGHT,
+            dump_on_failure,
+        )
+
+        dump_on_failure(
+            FAIL_ALL_FLIGHT, log=self.tracer,
+            error=type(err).__name__, message=str(err)[:500],
+            worker_restarts=self._worker_restarts,
+        )
         self._closing = True
         while self._pending:
             req = self._pending.popleft()
@@ -685,7 +709,18 @@ class AssignmentService:
         return self.tracer.span("serve_batch", **attrs)
 
     def _run_batch(self, batch, rows: int) -> None:
-        with self._batch_span(batch, rows) as sp:
+        # Per-batch stall deadline (ISSUE 14): armed only while a batch is
+        # actually in flight (an idle service parks nothing on the
+        # watchdog), tuned from the live serve_latency_seconds histogram
+        # with the 120 s floor — the tunnel's own kill horizon. Expiry
+        # dumps all-thread stacks; it never kills the batch.
+        from consensusclustr_tpu.obs.flight import stall_watch
+
+        with self._batch_span(batch, rows) as sp, stall_watch(
+            self.tracer, "serve_batch",
+            hist=self.metrics.histograms.get("serve_latency_seconds"),
+            floor_s=self._stall_floor_s,
+        ):
             try:
                 bucket = bucket_for(rows, self.buckets)
                 self.metrics.gauge("batch_occupancy").set(rows / bucket)
@@ -777,6 +812,8 @@ class AssignmentService:
                 # drain-rate observation (retry_after_s hint): a batch —
                 # served or failed — freed its queue slots at this instant
                 self._drain_window.append((time.perf_counter(), len(batch)))
+                if self._alerts is not None:
+                    self._alerts.evaluate()  # never raises
 
     # -- introspection -------------------------------------------------------
 
@@ -811,10 +848,18 @@ class AssignmentService:
 
     def health(self) -> dict:
         """Liveness/drain snapshot (the /healthz body): queue depth, requests
-        in flight, and the compiled-shape inventory."""
+        in flight, the compiled-shape inventory, and — the ROADMAP O3
+        routing signal (ISSUE 14) — the live SLO alert state: a scrape-time
+        evaluation so ``alerts_active``/``last_alert`` reflect NOW, not the
+        last batch."""
         status = (
             "closed" if self._closed else "draining" if self._closing else "ok"
         )
+        alerts_active: dict = {}
+        last_alert = None
+        if self._alerts is not None:
+            alerts_active = self._alerts.evaluate()  # never raises
+            last_alert = self._alerts.last_alert
         return {
             "status": status,
             "queue_depth": self._queue.qsize(),
@@ -825,6 +870,8 @@ class AssignmentService:
             "buckets": list(self.buckets),
             "bucket_compiles": self.bucket_compiles,
             "worker_restarts": self._worker_restarts,
+            "alerts_active": sorted(alerts_active),
+            "last_alert": dict(last_alert) if last_alert else None,
         }
 
     def run_record(self, config=None) -> RunRecord:

@@ -1,0 +1,636 @@
+"""Flight recorder + stall watchdog + SLO alert engine (ISSUE 14).
+
+Covers the ISSUE 14 checklist: bounded rings, the crash-hook dump paths
+(unhandled exception and SIGTERM, each in a subprocess so the hooks fire
+for real), the watchdog catching a planted wedge within 2x its deadline
+without perturbing a clean run, the alert-rule matrix (p99 bound,
+rejection rate, burn rate, counter monotonicity — windows driven by
+explicit timestamps), the schema v8 RunRecord round trip, the
+tools/postmortem.py render/diff contract, the report table, the extended
+static schema check, and the off-is-free pin (armed vs CCTPU_NO_FLIGHT=1:
+identical deterministic work, wall within noise).
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.api import consensus_clust
+from consensusclustr_tpu.obs import RunRecord, Tracer
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.obs.alerts import (
+    AOT_ALERT,
+    BURN_ALERT,
+    EXHAUSTED_ALERT,
+    P99_ALERT,
+    REJECTION_ALERT,
+    AlertEngine,
+    AlertRule,
+    attach_alerts,
+    default_alert_rules,
+)
+from consensusclustr_tpu.obs.flight import (
+    EXCEPTION_FLIGHT,
+    MANUAL_FLIGHT,
+    SIGNAL_FLIGHT,
+    STALL_FLIGHT,
+    FlightRecorder,
+    attach_flight,
+    dump_on_failure,
+    flight_enabled,
+    global_flight,
+    resolve_postmortem_path,
+    stall_deadline_s,
+    stall_watch,
+)
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_pca(seed=5, n=96, d=6):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 6, size=(3, d))
+    return (
+        centers[rng.integers(0, 3, size=n)] + rng.normal(0, 1, (n, d))
+    ).astype(np.float32)
+
+
+# -----------------------------------------------------------------------------
+# recorder: rings, dumps, attach wiring
+# -----------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_rings_are_bounded(self):
+        fr = FlightRecorder(
+            capacity=8, snapshot_capacity=4, log_lines=5,
+            attach_log_handler=False,
+        )
+        for i in range(50):
+            fr.note_event({"kind": "e", "i": i})
+            fr.log_lines.append(f"line {i}")
+        assert len(fr.events) == 8 and fr.events[-1]["i"] == 49
+        assert len(fr.log_lines) == 5 and fr.log_lines[0] == "line 45"
+        reg = MetricsRegistry()
+        tr = Tracer()
+        fr.track(tr)
+        fr.track(tr)  # idempotent
+        assert len(fr._tracers) == 1
+        for i in range(20):
+            tr.metrics.counter("boots_completed").inc()
+            fr.note_phase_delta(f"phase{i}")
+        assert len(fr.snapshots) == 4
+        # deltas, not totals: one counter step per snapshot
+        assert fr.snapshots[-1]["counters"] == {"boots_completed": 1.0}
+        assert reg.counters == {}  # untracked registry untouched
+
+    def test_dump_round_trip(self, tmp_path):
+        pm = _load_tool("postmortem")
+        from consensusclustr_tpu.obs import global_metrics
+
+        fr = FlightRecorder(attach_log_handler=False)
+        tr = Tracer()
+        fr.track(tr)
+        # the dump merges the process-global registry too, which other
+        # tests feed — compare against its value at dump time
+        boots0 = (
+            global_metrics().counters["boots_completed"].value
+            if "boots_completed" in global_metrics().counters else 0
+        )
+        tr.metrics.counter("boots_completed").inc(3)
+        fr.note_event({"t": 0.1, "kind": "checkpoint_write", "path": "x"})
+        path = str(tmp_path / "dump.json")
+        got = fr.dump(MANUAL_FLIGHT, {"why": "test"}, path=path)
+        assert got == path
+        assert fr.last_dump_path == path
+        assert fr.last_dump_reason == MANUAL_FLIGHT
+        d = pm.load_dump(path)
+        assert d["schema"] == obs_schema.SCHEMA_VERSION
+        assert d["reason"] == MANUAL_FLIGHT
+        assert d["detail"] == {"why": "test"}
+        assert d["events"][-1]["kind"] == "checkpoint_write"
+        assert d["metrics"]["counters"]["boots_completed"] == boots0 + 3
+        # every thread's stack is in the dump, including this one
+        assert any("MainThread" in k for k in d["threads"])
+        # second dump with no explicit path resolves the env/tmp chain
+        assert fr.dump(MANUAL_FLIGHT) is not None
+        assert fr.dumps == 2
+
+    def test_dump_never_raises(self):
+        fr = FlightRecorder(attach_log_handler=False)
+        # unwritable path: dump returns None instead of raising
+        assert fr.dump(MANUAL_FLIGHT, path="/proc/0/nope/dump.json") is None
+
+    def test_attach_flight_wires_events_and_spans(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_NO_FLIGHT", raising=False)
+        tr = Tracer()
+        rec = attach_flight(tr)
+        assert rec is not None and tr.flight is rec
+        assert attach_flight(tr) is rec  # idempotent: no double-wrap
+        n0 = len(rec.events)
+        tr.event("boot_chunk_done", i=1)
+        assert len(rec.events) == n0 + 1  # exactly once despite re-attach
+        assert rec.events[-1]["kind"] == "boot_chunk_done"
+        s0 = len(rec.spans)
+        with tr.span("ingest"):
+            tr.metrics.counter("boots_completed").inc()
+        assert len(rec.spans) >= s0 + 1
+        assert rec.snapshots[-1]["phase"] == "ingest"
+
+    def test_path_resolution_order(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CCTPU_POSTMORTEM_DIR", str(tmp_path))
+        p = resolve_postmortem_path(seq=3)
+        assert p.startswith(str(tmp_path)) and p.endswith("-3.json")
+        monkeypatch.setenv("CCTPU_POSTMORTEM_PATH", str(tmp_path / "x.json"))
+        assert resolve_postmortem_path() == str(tmp_path / "x.json")
+
+    def test_dump_on_failure_disarmed_is_none(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_NO_FLIGHT", "1")
+        assert not flight_enabled()
+        assert dump_on_failure(MANUAL_FLIGHT) is None
+
+
+# -----------------------------------------------------------------------------
+# crash hooks: the subprocess truth tests
+# -----------------------------------------------------------------------------
+
+
+def _child_env(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CCTPU_POSTMORTEM_PATH"] = str(tmp_path / "postmortem.json")
+    env.pop("CCTPU_NO_FLIGHT", None)
+    return env
+
+
+class TestCrashHooks:
+    def test_unhandled_exception_dumps(self, tmp_path):
+        env = _child_env(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from consensusclustr_tpu.obs.flight import global_flight\n"
+             "assert global_flight() is not None\n"
+             "raise RuntimeError('planted crash')\n"],
+            env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1
+        d = json.load(open(env["CCTPU_POSTMORTEM_PATH"]))
+        assert d["reason"] == EXCEPTION_FLIGHT
+        assert d["detail"]["error"] == "RuntimeError"
+        assert d["detail"]["message"] == "planted crash"
+        assert d["schema"] == obs_schema.SCHEMA_VERSION
+        # the chained previous excepthook (the default) still printed it
+        assert "planted crash" in proc.stderr
+
+    def test_sigterm_dumps_and_dies_with_signal(self, tmp_path):
+        env = _child_env(tmp_path)
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c",
+             "import time\n"
+             "from consensusclustr_tpu.obs.flight import global_flight\n"
+             "assert global_flight() is not None\n"
+             "print('READY', flush=True)\n"
+             "time.sleep(120)\n"],
+            env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        # handler chains to the default disposition: the process still
+        # dies *of* SIGTERM, not of a tidy exit
+        assert proc.returncode == -signal.SIGTERM
+        d = json.load(open(env["CCTPU_POSTMORTEM_PATH"]))
+        assert d["reason"] == SIGNAL_FLIGHT
+        assert d["detail"]["signal"] == "SIGTERM"
+        assert any(d["threads"])  # stacks captured at signal time
+
+
+# -----------------------------------------------------------------------------
+# stall watchdog
+# -----------------------------------------------------------------------------
+
+
+class TestStallWatchdog:
+    def test_deadline_resolution(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_STALL_FLOOR_S", raising=False)
+        monkeypatch.delenv("CCTPU_STALL_FACTOR", raising=False)
+        assert stall_deadline_s() == 120.0  # cold start: the floor
+        reg = MetricsRegistry()
+        h = reg.histogram("boot_chunk_seconds")
+        for _ in range(20):
+            h.observe(40.0)
+        # warm histogram: p99 * factor beats the floor
+        assert stall_deadline_s(h) > 120.0
+        monkeypatch.setenv("CCTPU_STALL_FLOOR_S", "7")
+        assert stall_deadline_s() == 7.0
+        with pytest.raises(ValueError):
+            stall_deadline_s(floor_s=-1.0)
+
+    def test_catches_planted_wedge_within_2x_deadline(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.delenv("CCTPU_NO_FLIGHT", raising=False)
+        monkeypatch.setenv(
+            "CCTPU_POSTMORTEM_PATH", str(tmp_path / "stall.json")
+        )
+        tr = Tracer()
+        attach_flight(tr)
+        fired_at = []
+        deadline = 0.4
+        t0 = time.monotonic()
+        with stall_watch(
+            tr, "planted_wedge", deadline_s=deadline,
+            escalate=lambda: fired_at.append(time.monotonic()),
+        ):
+            time.sleep(3 * deadline)  # the wedge
+        assert fired_at, "watchdog never fired on a planted stall"
+        assert fired_at[0] - t0 <= 2 * deadline
+        assert tr.metrics.counters["stalls_detected"].value == 1
+        stall_evs = [e for e in tr.events if e["kind"] == "stall_detected"]
+        assert stall_evs and stall_evs[0]["name"] == "planted_wedge"
+        d = json.load(open(str(tmp_path / "stall.json")))
+        assert d["reason"] == STALL_FLIGHT
+        assert d["detail"]["watch"] == "planted_wedge"
+        # the wedged (main) thread's stack is in the dump
+        assert any("MainThread" in k for k in d["threads"])
+
+    def test_tick_rearms_and_clean_run_unperturbed(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_NO_FLIGHT", raising=False)
+        tr = Tracer()
+        fired = []
+        with stall_watch(
+            tr, "chunk_loop", deadline_s=0.4, escalate=fired.append,
+        ) as watch:
+            for _ in range(4):
+                time.sleep(0.15)  # 0.6 s total, but each tick re-arms
+                watch.tick()
+        assert not fired
+        assert "stalls_detected" not in tr.metrics.counters
+        assert not any(e["kind"] == "stall_detected" for e in tr.events)
+
+    def test_disarmed_yields_null_watch(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_NO_FLIGHT", "1")
+        with stall_watch(None, "x", deadline_s=0.001) as w:
+            w.tick()  # inert handle, no thread, no firing
+            time.sleep(0.05)
+        assert type(w).__name__ == "_NullWatch"
+
+
+# -----------------------------------------------------------------------------
+# alert engine: the rule matrix (explicit timestamps drive the windows)
+# -----------------------------------------------------------------------------
+
+
+class TestAlertRules:
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            AlertRule("x", "nonsense_kind")
+        with pytest.raises(ValueError):
+            AlertRule("", "rate")
+        with pytest.raises(ValueError):
+            AlertRule("x", "rate", window_s=0.0)
+
+    def test_default_rules_match_schema_registry(self):
+        names = {r.name for r in default_alert_rules()}
+        assert names == set(obs_schema.ALERT_RULES)
+        assert names == {
+            P99_ALERT, REJECTION_ALERT, BURN_ALERT, EXHAUSTED_ALERT,
+            AOT_ALERT,
+        }
+
+    def _rate_engine(self, tr=None):
+        reg = MetricsRegistry()
+        rule = AlertRule(
+            REJECTION_ALERT, "rate",
+            bad="serve_rejections", good="hist:serve_latency_seconds",
+            threshold=0.05, window_s=60.0, min_events=10,
+        )
+        return reg, AlertEngine([reg], rules=(rule,), tracer=tr)
+
+    def test_rate_raises_then_clears_on_window_roll(self):
+        tr = Tracer()
+        reg, eng = self._rate_engine(tr)
+        assert eng.evaluate(now=0.0) == {}  # base sample
+        h = reg.histogram("serve_latency_seconds")
+        for _ in range(20):
+            h.observe(0.01)
+        reg.counter("serve_rejections").inc(5)
+        active = eng.evaluate(now=1.0)
+        assert REJECTION_ALERT in active
+        assert active[REJECTION_ALERT]["value"] == pytest.approx(0.2)
+        assert eng.raised_total == 1
+        assert tr.metrics.gauges["alerts_active"].value == 1
+        assert tr.metrics.counters["alerts_raised"].value == 1
+        assert [e["kind"] for e in tr.events] == ["alert_raised"]
+        # still firing: level-triggered, since_s sticks, no re-raise
+        again = eng.evaluate(now=2.0)
+        assert again[REJECTION_ALERT]["since_s"] == active[
+            REJECTION_ALERT
+        ]["since_s"]
+        assert eng.raised_total == 1
+        # window rolls past the bad burst with no new traffic: clears
+        assert eng.evaluate(now=120.0) == {}
+        assert eng.cleared_total == 1
+        assert tr.metrics.gauges["alerts_active"].value == 0
+        assert tr.events[-1]["kind"] == "alert_cleared"
+        # last_alert survives the clear (the health() breadcrumb)
+        assert eng.last_alert["name"] == REJECTION_ALERT
+
+    def test_rate_below_min_events_stays_quiet(self):
+        reg, eng = self._rate_engine()
+        eng.evaluate(now=0.0)
+        reg.counter("serve_rejections").inc(3)  # 3 events < min 10, 100% bad
+        assert eng.evaluate(now=1.0) == {}
+
+    def test_burn_rate_windows(self):
+        reg = MetricsRegistry()
+        rule = AlertRule(
+            BURN_ALERT, "burn_rate",
+            bad="serve_rejections", good="hist:serve_latency_seconds",
+            budget=0.01, factor=10.0, window_s=300.0, min_events=20,
+        )
+        eng = AlertEngine([reg], rules=(rule,))
+        eng.evaluate(now=0.0)
+        h = reg.histogram("serve_latency_seconds")
+        for _ in range(45):
+            h.observe(0.01)
+        reg.counter("serve_rejections").inc(5)
+        # 5/50 = 0.1 bad fraction = 10x the 0.01 budget: burning
+        active = eng.evaluate(now=5.0)
+        assert BURN_ALERT in active
+        assert active[BURN_ALERT]["value"] == pytest.approx(10.0)
+        # same totals seen from beyond the window: delta is zero, clears
+        assert eng.evaluate(now=400.0) == {}
+        # sub-budget traffic never fires: 1/101 < 10 * 0.01
+        for _ in range(100):
+            h.observe(0.01)
+        reg.counter("serve_rejections").inc(1)
+        assert eng.evaluate(now=401.0) == {}
+
+    def test_counter_increase_fires_and_clears(self):
+        reg = MetricsRegistry()
+        rule = AlertRule(
+            EXHAUSTED_ALERT, "counter_increase",
+            counter="retries_exhausted", window_s=60.0,
+        )
+        eng = AlertEngine([reg], rules=(rule,))
+        assert eng.evaluate(now=0.0) == {}
+        reg.counter("retries_exhausted").inc()
+        active = eng.evaluate(now=1.0)
+        assert EXHAUSTED_ALERT in active and active[EXHAUSTED_ALERT][
+            "value"
+        ] == 1.0
+        # no further increase: the window slides past it and the alert clears
+        assert eng.evaluate(now=120.0) == {}
+
+    def test_p99_bound(self):
+        reg = MetricsRegistry()
+        rule = AlertRule(
+            P99_ALERT, "p99_bound",
+            hist="serve_latency_seconds", bound_s=0.05, min_count=10,
+        )
+        eng = AlertEngine([reg], rules=(rule,))
+        h = reg.histogram("serve_latency_seconds")
+        for _ in range(9):
+            h.observe(5.0)
+        assert eng.evaluate(now=1.0) == {}  # under min_count: untrusted
+        for _ in range(11):
+            h.observe(5.0)
+        active = eng.evaluate(now=2.0)
+        assert P99_ALERT in active
+        assert active[P99_ALERT]["value"] > 0.05
+        # a fast histogram never fires
+        reg2 = MetricsRegistry()
+        h2 = reg2.histogram("serve_latency_seconds")
+        for _ in range(50):
+            h2.observe(0.001)
+        eng2 = AlertEngine([reg2], rules=(rule,))
+        assert eng2.evaluate(now=1.0) == {}
+
+    def test_evaluate_never_raises(self):
+        class Broken:
+            @property
+            def counters(self):
+                raise RuntimeError("poisoned registry")
+
+            histograms = {}
+
+        eng = AlertEngine([Broken()])
+        assert eng.evaluate(now=1.0) == {}
+
+    def test_summary_shape_and_attach(self):
+        tr = Tracer()
+        eng = attach_alerts(tr)
+        assert attach_alerts(tr) is eng  # idempotent
+        assert attach_alerts(None) is None
+        s = eng.summary()
+        assert set(s) == {
+            "active", "raised_total", "cleared_total", "last_alert", "rules",
+        }
+        assert s["rules"] == sorted(r.name for r in default_alert_rules())
+
+
+# -----------------------------------------------------------------------------
+# schema v8: registries, RunRecord round trip, report, static check
+# -----------------------------------------------------------------------------
+
+
+class TestSchemaV8:
+    def test_registry_entries(self):
+        assert obs_schema.SCHEMA_VERSION == 8
+        for kind in (
+            "stall_detected", "postmortem_dump", "alert_raised",
+            "alert_cleared",
+        ):
+            assert kind in obs_schema.EVENT_KINDS
+        for name in (
+            "stalls_detected", "postmortem_dumps", "alerts_raised",
+            "alerts_active",
+        ):
+            assert name in obs_schema.METRIC_NAMES
+        assert obs_schema.FLIGHT_EVENT_KINDS == {
+            "exception", "signal", "fail_all", "retries_exhausted",
+            "stall", "manual",
+        }
+
+    def test_run_record_round_trip(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("CCTPU_NO_FLIGHT", raising=False)
+        tr = Tracer()
+        rec_path = str(tmp_path / "manual.json")
+        attach_flight(tr)
+        attach_alerts(tr)
+        with tr.span("work"):
+            tr.metrics.counter("boots_completed").inc()
+        tr.flight.dump(MANUAL_FLIGHT, path=rec_path)
+        rec = RunRecord.from_tracer(tr)
+        assert rec.schema == 8
+        assert rec.postmortem_path == rec_path
+        assert rec.alerts is not None and rec.alerts["active"] == {}
+        path = str(tmp_path / "rec.jsonl")
+        rec.write(path)
+        from consensusclustr_tpu.obs import load_records
+
+        back = load_records(path)[-1]
+        assert back.postmortem_path == rec_path
+        assert back.alerts == rec.alerts
+
+    def test_report_alerts_table(self):
+        report = _load_tool("report")
+        assert 8 in report.KNOWN_SCHEMAS
+        rec = {
+            "schema": 8,
+            "alerts": {
+                "active": {
+                    REJECTION_ALERT: {"value": 0.2, "threshold": 0.05},
+                },
+                "raised_total": 2, "cleared_total": 1,
+                "last_alert": {"name": REJECTION_ALERT, "value": 0.2},
+                "rules": [REJECTION_ALERT],
+            },
+            "postmortem_path": "/tmp/pm.json",
+        }
+        out = report.render(rec)
+        assert "== alerts ==" in out
+        assert REJECTION_ALERT in out and "/tmp/pm.json" in out
+        # absent block renders the placeholder, never an error
+        assert "schema < 8" in report.alerts({"schema": 7})
+
+    def test_static_schema_check_passes(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "check_obs_schema.py")],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -----------------------------------------------------------------------------
+# tools/postmortem.py: render + diff contract
+# -----------------------------------------------------------------------------
+
+
+class TestPostmortemTool:
+    def _dump(self, tmp_path, name, reason, counter=0):
+        fr = FlightRecorder(attach_log_handler=False)
+        tr = Tracer()
+        fr.track(tr)
+        tr.metrics.counter("retry_attempts").inc(counter)
+        fr.note_event({"t": 0.0, "kind": reason, "site": name})
+        p = str(tmp_path / f"{name}.json")
+        assert fr.dump(reason, {"site": name}, path=p) == p
+        return p
+
+    def test_render(self, tmp_path):
+        pm = _load_tool("postmortem")
+        p = self._dump(tmp_path, "a", MANUAL_FLIGHT, counter=2)
+        out = "\n".join(pm.render_dump(pm.load_dump(p), p))
+        assert "reason=manual" in out
+        assert "retry_attempts" in out
+        assert "threads at death" in out
+
+    def test_diff_reports_differences_rc0(self, tmp_path):
+        pm = _load_tool("postmortem")
+        a = self._dump(tmp_path, "a", MANUAL_FLIGHT, counter=2)
+        b = self._dump(tmp_path, "b", STALL_FLIGHT, counter=5)
+        lines, rc = pm.diff_dumps(
+            pm.load_dump(a), pm.load_dump(b), a, b
+        )
+        assert rc == 0  # differences are the report, not an error
+        joined = "\n".join(lines)
+        assert "[DIFFERS]" in joined and "retry_attempts" in joined
+
+    def test_diff_schema_mismatch_rc2(self, tmp_path):
+        pm = _load_tool("postmortem")
+        a = self._dump(tmp_path, "a", MANUAL_FLIGHT)
+        old = pm.load_dump(a)
+        old["schema"] = 7
+        lines, rc = pm.diff_dumps(pm.load_dump(a), old, a, "old")
+        assert rc == 2
+
+    def test_load_rejects_non_dump(self, tmp_path):
+        pm = _load_tool("postmortem")
+        p = str(tmp_path / "not_a_dump.json")
+        with open(p, "w") as f:
+            json.dump({"hello": "world"}, f)
+        with pytest.raises(ValueError):
+            pm.load_dump(p)
+        with pytest.raises(ValueError):
+            pm.load_dump(str(tmp_path / "missing.json"))
+
+
+# -----------------------------------------------------------------------------
+# serving surface + off-is-free
+# -----------------------------------------------------------------------------
+
+
+class TestIntegration:
+    def test_health_carries_alert_state(self):
+        lg = _load_tool("loadgen")
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art, _ = lg.synthetic_artifact(128, 32, seed=0)
+        with AssignmentService(
+            art, max_batch=8, queue_depth=4, buckets=(8,)
+        ) as svc:
+            h = svc.health()
+        assert h["alerts_active"] == []
+        assert h["last_alert"] is None
+        assert "worker_restarts" in h
+
+    def test_off_is_free(self, monkeypatch, tmp_path):
+        """CCTPU_NO_FLIGHT=1 vs armed: identical labels, identical
+        deterministic work ledger, wall within noise — the recorder's
+        steady-state cost is ring appends, so off buys nothing."""
+        kw = dict(
+            pca=_tiny_pca(), pc_num=6, nboots=2, k_num=(5,),
+            res_range=(0.3,), max_clusters=16, test_significance=False,
+        )
+        consensus_clust(**kw)  # warmup: compiles on neither side's clock
+
+        def run():
+            t0 = time.perf_counter()
+            res = consensus_clust(**kw)
+            return res, time.perf_counter() - t0
+
+        monkeypatch.delenv("CCTPU_NO_FLIGHT", raising=False)
+        armed, wall_armed = run()
+        recorder = global_flight()
+        monkeypatch.setenv("CCTPU_NO_FLIGHT", "1")
+        off, wall_off = run()
+
+        assert np.array_equal(armed.assignments, off.assignments)
+        wa = armed.run_record.work_ledger
+        wo = off.run_record.work_ledger
+        assert wa is not None and wa["counters"] == wo["counters"]
+        # generous noise bound: same order of magnitude, not a benchmark
+        assert wall_armed <= 3.0 * wall_off + 0.5
+        # the armed run actually recorded (rings fed, alerts attached);
+        # neither run dumped (clean runs never write)
+        assert recorder is not None and len(recorder.spans) > 0
+        assert armed.run_record.alerts is not None
+        assert armed.run_record.postmortem_path in (
+            None, recorder.last_dump_path,
+        )
+        assert off.run_record.postmortem_path is None

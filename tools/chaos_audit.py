@@ -48,6 +48,17 @@ Presets (fault site x a transient kind, plus the failure-semantics checks):
   permanent     boot_chunk:raise_always — the NEGATIVE control: retries must
                 exhaust (fires == policy attempts) and the original
                 InjectedFault must surface, not be swallowed.
+  postmortem    serve_worker:raise_always — the black-box audit (ISSUE 14):
+                the worker dies past its restart limit, _fail_all dumps the
+                flight recorder, and the dump must (a) load as a schema-v8
+                post-mortem, (b) name the planted fault site in its tail
+                events (the serve_worker_restart trail), and (c) carry a
+                metrics snapshot equal to the live merged registries at
+                death. A second dump from a permanent boot_chunk fault
+                (the retry-exhaustion trigger) must name ITS site, and
+                ``tools/postmortem.py diff`` over the pair must exit 0 —
+                two different failure modes, two dumps that differ exactly
+                at the fault sites.
 
 Exit codes: 0 all presets recovered bit-identically; 1 usage; 3 divergence,
 non-recovery, or a planted fault that never fired.
@@ -80,6 +91,7 @@ PRESETS: Dict[str, Tuple[Optional[str], str]] = {
     "serve_batch": ("serve_batch:raise_once", "serve"),
     "serve_worker": ("serve_worker:raise_once", "serve"),
     "permanent": ("boot_chunk:raise_always", "permanent"),
+    "postmortem": ("serve_worker:raise_always", "postmortem"),
 }
 
 
@@ -201,6 +213,41 @@ class ChaosHarness:
             self._clean_serve, _ = self.serve_run()
         return self._clean_serve
 
+    def serve_crash_run(self, pm_path: str):
+        """Drive the service into its give-up path (a permanent worker
+        fault must exhaust the restart budget and _fail_all) with the
+        post-mortem routed to ``pm_path``. Returns (surfaced exception
+        name, live merged counter totals right after death)."""
+        from consensusclustr_tpu.obs.flight import global_flight
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        art = self.artifact()
+        prev = os.environ.get("CCTPU_POSTMORTEM_PATH")
+        os.environ["CCTPU_POSTMORTEM_PATH"] = pm_path
+        surfaced = None
+        try:
+            with AssignmentService(
+                art, queue_depth=8, max_batch=16, buckets=(16,), start=False
+            ) as svc:
+                futures = [svc.submit(self.counts[:1])]
+                svc.start()
+                try:
+                    futures[0].result(timeout=120)
+                except Exception as e:
+                    surfaced = type(e).__name__
+            # counter state the dump's snapshot must equal: same merge the
+            # recorder itself performs (global + every tracked registry);
+            # nothing increments between the death dump and this read
+            # except the dump bookkeeping itself (excluded by the caller)
+            recorder = global_flight()
+            live = recorder._counter_totals() if recorder else {}
+        finally:
+            if prev is None:
+                os.environ.pop("CCTPU_POSTMORTEM_PATH", None)
+            else:
+                os.environ["CCTPU_POSTMORTEM_PATH"] = prev
+        return surfaced, live
+
     # -- null statistics -----------------------------------------------------
 
     def null_run(self) -> str:
@@ -246,6 +293,16 @@ def _tear_checkpoint(files: List[str]) -> int:
             f.write(b"\x00CHAOS\x00" * 8)
         damaged += 1
     return damaged
+
+
+def _tail_names_site(dump: dict, site: str, n: int = 15) -> bool:
+    """Do the dump's final ring events name the planted fault site — either
+    in the event kind (serve_worker_restart) or a site= field (retry /
+    retries_exhausted)?"""
+    for ev in (dump.get("events") or [])[-n:]:
+        if site in str(ev.get("kind", "")) or ev.get("site") == site:
+            return True
+    return False
 
 
 def audit_preset(name: str, harness: ChaosHarness) -> dict:
@@ -368,6 +425,79 @@ def audit_preset(name: str, harness: ChaosHarness) -> dict:
             else:
                 out.update(recovered=True, surfaced=None)
                 out["ok"] = False  # a permanent fault must not "succeed"
+
+        elif workload == "postmortem":
+            # the black-box audit (ISSUE 14): two different failure modes
+            # must each leave a loadable post-mortem naming their fault
+            # site, and the pair must diff cleanly via tools/postmortem.py
+            import subprocess
+
+            if _HERE not in sys.path:
+                sys.path.insert(0, _HERE)
+            import postmortem as pm_tool
+
+            from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+
+            pm_a = os.path.join(harness.root, "pm_worker.json")
+            pm_b = os.path.join(harness.root, "pm_permanent.json")
+            inj = install_fault(spec)
+            surfaced, live = harness.serve_crash_run(pm_a)
+            clear_fault()
+            fires_a = inj.total_fires
+            dump_a = pm_tool.load_dump(pm_a)  # ValueError -> preset failure
+            counters_a = (dump_a.get("metrics") or {}).get("counters", {})
+            # the dump's snapshot vs the live merge at death: exact, except
+            # the dump's own bookkeeping counter (incremented post-snapshot)
+            names = (set(counters_a) | set(live)) - {"postmortem_dumps"}
+            metrics_match = all(
+                float(counters_a.get(k, 0.0)) == float(live.get(k, 0.0))
+                for k in names
+            )
+            # dump B: the retry-exhaustion trigger on a permanent
+            # consensus fault (the `permanent` preset's failure mode)
+            prev = os.environ.get("CCTPU_POSTMORTEM_PATH")
+            os.environ["CCTPU_POSTMORTEM_PATH"] = pm_b
+            inj = install_fault("boot_chunk:raise_always")
+            try:
+                harness.consensus_run()
+                exhausted_surfaced = False
+            except InjectedFault:
+                exhausted_surfaced = True
+            finally:
+                clear_fault()
+                if prev is None:
+                    os.environ.pop("CCTPU_POSTMORTEM_PATH", None)
+                else:
+                    os.environ["CCTPU_POSTMORTEM_PATH"] = prev
+            dump_b = pm_tool.load_dump(pm_b)
+            diff = subprocess.run(
+                [
+                    sys.executable, os.path.join(_HERE, "postmortem.py"),
+                    "diff", pm_a, pm_b,
+                ],
+                capture_output=True, text=True,
+            )
+            out.update(
+                recovered=False, surfaced=surfaced,
+                dump_schema=dump_a.get("schema"),
+                dump_reasons=[dump_a.get("reason"), dump_b.get("reason")],
+                tail_names_site=_tail_names_site(dump_a, "serve_worker"),
+                tail_names_site_b=_tail_names_site(dump_b, "boot_chunk"),
+                metrics_match=metrics_match,
+                exhausted_surfaced=exhausted_surfaced,
+                diff_rc=diff.returncode,
+            )
+            out["ok"] = (
+                fires_a >= 2
+                and dump_a.get("schema") == SCHEMA_VERSION
+                and dump_b.get("schema") == SCHEMA_VERSION
+                and out["tail_names_site"]
+                and out["tail_names_site_b"]
+                and metrics_match
+                and exhausted_surfaced
+                and diff.returncode == 0
+            )
+            out["fires"] = fires_a
         else:  # pragma: no cover - registry and drivers move together
             raise AssertionError(f"unknown workload {workload!r}")
     except Exception as e:

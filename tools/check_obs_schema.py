@@ -61,6 +61,16 @@ import-failure fallback literals in bench.py (``_DISPATCH_FALLBACK`` /
 ast-pinned to obs.ledger — the bench failure payload must stay
 key-identical to real rungs even when the package cannot import.
 
+Since ISSUE 14 the failure layer rides the same rails:
+``obs/alerts.py``'s ``*_ALERT`` constants <-> ``obs.schema.ALERT_RULES``
+and ``obs/flight.py``'s ``*_FLIGHT`` constants <->
+``obs.schema.FLIGHT_EVENT_KINDS`` (both directions — every registered
+rule/dump-reason must have a defining constant, every constant must be
+registered), while ``serve/service.py`` and the cross-module consumers
+(flight.py's ``*_ALERT`` uses, alerts.py's ``*_FLIGHT`` uses) are held to
+registered-only — same contract as FAULT_SITES. A renamed alert rule is a
+test failure, not a dashboard paging on a series that no longer exists.
+
 Usage: python tools/check_obs_schema.py [repo_root]
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -99,6 +109,14 @@ WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""
 # ops/pallas_snn.py SNN-impl constants: NAME_SNN_IMPL = "literal"
 SNN_IMPL_RE = re.compile(
     r"""^([A-Z][A-Z0-9_]*_SNN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# obs/alerts.py alert-rule constants: NAME_ALERT = "literal"
+ALERT_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_ALERT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# obs/flight.py dump-reason constants: NAME_FLIGHT = "literal"
+FLIGHT_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_FLIGHT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
 )
 # literal site names at fault-spec strings in tools/chaos_audit.py presets:
 # "site:kind[:arg]" — the first segment must be a registered fault site
@@ -403,6 +421,44 @@ def check_snn_impls(root: str) -> List[str]:
     return errors
 
 
+def check_flight_alerts(root: str) -> List[str]:
+    """ISSUE 14: the failure-layer registries, both directions.
+
+    * obs/alerts.py ``*_ALERT`` literals <-> schema.ALERT_RULES (complete:
+      every registered rule must have a defining constant — consumers
+      import these, so an unbacked registry entry is a rule nothing can
+      reference);
+    * obs/flight.py ``*_FLIGHT`` literals <-> schema.FLIGHT_EVENT_KINDS
+      (complete, same contract — dump reasons are the post-mortem
+      vocabulary);
+    * serve/service.py and the cross-module consumers (flight.py's
+      ``*_ALERT``, alerts.py's ``*_FLIGHT``) registered-only — they consume
+      the vocabulary, they define none of it.
+    """
+    alerts_rel = os.path.join("consensusclustr_tpu", "obs", "alerts.py")
+    flight_rel = os.path.join("consensusclustr_tpu", "obs", "flight.py")
+    service_rel = os.path.join("consensusclustr_tpu", "serve", "service.py")
+    errors = _check_constant_registry(
+        root, alerts_rel, ALERT_RE, "ALERT_RULES", "alert rule",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, flight_rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
+        require_complete=True,
+    )
+    for rel in (service_rel, flight_rel):
+        errors += _check_constant_registry(
+            root, rel, ALERT_RE, "ALERT_RULES", "alert rule",
+            require_complete=False,
+        )
+    for rel in (service_rel, alerts_rel):
+        errors += _check_constant_registry(
+            root, rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
+            require_complete=False,
+        )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
@@ -413,6 +469,7 @@ def check(root: str) -> List[str]:
         + check_fault_sites(root)
         + check_work_ledger(root)
         + check_snn_impls(root)
+        + check_flight_alerts(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

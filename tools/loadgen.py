@@ -37,6 +37,13 @@ result's ``AssignResult.timing`` decomposition is audited too: the
 ``phase_parity`` block proves per-request queue_wait + batch_wait + device
 sums to the end-to-end latency.
 
+Since ISSUE 14 every ladder step also records the service's SLO alert
+state (``alerts`` block: rules active at end of step, raise/clear totals,
+last alert raised — obs/alerts.py): the saturation step must show
+``serve_rejection_rate_high`` active and the sub-saturation steps must
+not, which BENCH_*.json commits as evidence the alert engine fires where
+the SLO actually breaks and stays quiet where it doesn't.
+
 The schedule/quantile/mix helpers are stdlib-only and importable without
 numpy or the package (bench.py and the tests reuse them); only the driver
 functions that build artifacts and query matrices need the stack.
@@ -370,6 +377,24 @@ def estimate_capacity(
     return n_requests / (time.perf_counter() - t0)
 
 
+def step_alerts(svc) -> Optional[dict]:
+    """The service's SLO alert state for one ladder step (ISSUE 14): a
+    final engine evaluation flattened to the fields the bench gates on —
+    which rules are active at end of step, how many raise/clear
+    transitions fired, and the last rule raised. None when the service has
+    no engine (never in this repo; defensive for forks)."""
+    engine = getattr(svc.tracer, "alert_engine", None)
+    if engine is None:
+        return None
+    s = engine.summary()
+    return {
+        "active": sorted(s["active"]),
+        "raised_total": s["raised_total"],
+        "cleared_total": s["cleared_total"],
+        "last_alert": (s["last_alert"] or {}).get("name"),
+    }
+
+
 def slo_ladder(
     artifact,
     rates: Sequence[float],
@@ -407,6 +432,13 @@ def slo_ladder(
                         timeout=timeout,
                     )
                 )
+                # alert firings per offered-rate step (ISSUE 14): the
+                # saturation step must show the rejection-rate rule
+                # active; sub-saturation steps must not — each step's
+                # fresh service gives the rule a clean window
+                alerts = step_alerts(svc)
+                if alerts is not None:
+                    step["alerts"] = alerts
         except Exception as e:  # the rung must emit every step
             step["error"] = str(e)[:200]
         steps.append(step)
@@ -508,15 +540,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.ladder:
         print(f"{'target':>8} {'offered':>8} {'goodput':>8} {'reject':>7} "
-              f"{'p50ms':>8} {'p99ms':>8} {'p999ms':>8}")
+              f"{'p50ms':>8} {'p99ms':>8} {'p999ms':>8}  alerts")
         for s in summary["steps"]:
             if "error" in s:
                 print(f"{s['target_rps']:>8} ERROR {s['error']}")
                 continue
+            active = ",".join((s.get("alerts") or {}).get("active", []))
             print(f"{s['target_rps']:>8} {s['offered_rps']:>8} "
                   f"{s['goodput_rps']:>8} {s['rejection_rate']:>7.3f} "
                   f"{s['p50_ms'] or 0:>8} {s['p99_ms'] or 0:>8} "
-                  f"{s['p999_ms'] or 0:>8}")
+                  f"{s['p999_ms'] or 0:>8}  {active or '-'}")
         return 0
     print(f"offered {summary['offered_rps']} rps "
           f"(target {summary['target_rps']}), "

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Render / diff flight-recorder post-mortem dumps (obs/flight.py).
+
+Usage:
+    python tools/postmortem.py render DUMP.json          # human summary
+    python tools/postmortem.py render DUMP.json --events 40
+    python tools/postmortem.py diff A.json B.json        # structured diff
+
+``render`` prints the black-box story of one process death: why it dumped
+(reason + detail), the tail of the event ring (what the system was doing),
+the per-phase metric deltas, every thread's stack at the moment of death,
+and the log tail. ``diff`` compares two dumps — reason, tail-event kinds,
+and the merged counter totals — so a chaos run can assert that two
+different failure modes (say a killed serve worker vs a permanent
+boot-chunk fault) left dumps that differ exactly where the fault sites
+differ (tools/chaos_audit.py ``postmortem`` preset).
+
+Exit codes: 0 clean render/diff; 1 unloadable/malformed dump;
+2 schema mismatch between the two diff sides. A *different* reason or
+counter delta between diff sides is NOT an error — reporting the
+difference is the tool's job.
+
+Standalone: stdlib-only, no package import (dumps are plain JSON and must
+stay readable on a host where the package itself is broken — that is the
+point of a black box).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REQUIRED_KEYS = ("schema", "flight_dump_version", "reason", "events")
+
+
+def load_dump(path: str) -> dict:
+    """Parse + structurally validate one dump; raises ValueError on a file
+    that is not a flight-recorder post-mortem."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except OSError as e:
+        raise ValueError(f"{path}: unreadable: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: not JSON: {e}")
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: dump must be a JSON object")
+    missing = [k for k in REQUIRED_KEYS if k not in d]
+    if missing:
+        raise ValueError(
+            f"{path}: not a flight-recorder dump (missing {missing})"
+        )
+    return d
+
+
+def _counter_totals(dump: dict) -> Dict[str, float]:
+    """Counters from the dump's merged metrics snapshot (plus histogram
+    observation counts under ``hist:<name>``, same key space the alert
+    engine reads)."""
+    out: Dict[str, float] = {}
+    mets = dump.get("metrics") or {}
+    for name, v in (mets.get("counters") or {}).items():
+        try:
+            out[name] = float(v)
+        except (TypeError, ValueError):
+            pass
+    for name, h in (mets.get("histograms") or {}).items():
+        try:
+            out["hist:" + name] = float(h.get("count", 0))
+        except (TypeError, ValueError, AttributeError):
+            pass
+    return out
+
+
+def _fmt_fields(d: dict, skip: Tuple[str, ...] = ()) -> str:
+    return " ".join(
+        f"{k}={d[k]!r}" for k in sorted(d) if k not in skip
+    )
+
+
+def render_dump(dump: dict, path: str, n_events: int = 20) -> List[str]:
+    lines: List[str] = []
+    lines.append(f"== post-mortem: {path} ==")
+    lines.append(
+        f"reason={dump.get('reason')} schema={dump.get('schema')} "
+        f"dump_version={dump.get('flight_dump_version')} "
+        f"pid={dump.get('pid')} seq={dump.get('dump_seq')}"
+    )
+    lines.append(
+        f"time_unix={dump.get('time_unix')} "
+        f"uptime_s={dump.get('uptime_s')}"
+    )
+    detail = dump.get("detail") or {}
+    if detail:
+        lines.append("detail: " + _fmt_fields(detail))
+
+    events = dump.get("events") or []
+    lines.append(f"-- events (last {min(n_events, len(events))} "
+                 f"of {len(events)} in ring) --")
+    for ev in events[-n_events:]:
+        ev = dict(ev)
+        t = ev.pop("t", None)
+        kind = ev.pop("kind", "?")
+        lines.append(f"  t={t:<10} {kind:<24} {_fmt_fields(ev)}")
+
+    spans = dump.get("spans") or []
+    if spans:
+        lines.append(f"-- spans (last {len(spans)} closed) --")
+        for sp in spans[-n_events:]:
+            lines.append(
+                f"  {sp.get('name', '?'):<24} "
+                f"seconds={sp.get('seconds')}"
+            )
+
+    deltas = dump.get("metric_deltas") or []
+    if deltas:
+        lines.append(f"-- metric deltas ({len(deltas)} snapshots) --")
+        for snap in deltas[-5:]:
+            snap = dict(snap)
+            phase = snap.pop("phase", "?")
+            t = snap.pop("t", None)
+            moved = {k: v for k, v in snap.items() if v}
+            lines.append(f"  t={t:<10} {phase:<16} {_fmt_fields(moved)}")
+
+    counters = _counter_totals(dump)
+    moved = {k: v for k, v in sorted(counters.items()) if v}
+    if moved:
+        lines.append("-- counter totals at death --")
+        width = max(len(k) for k in moved)
+        for k, v in moved.items():
+            lines.append(f"  {k:<{width}}  {v:g}")
+
+    threads = dump.get("threads") or {}
+    lines.append(f"-- threads at death ({len(threads)}) --")
+    for name, frames in threads.items():
+        lines.append(f"  [{name}]")
+        for fr in frames[-8:]:
+            for ln in str(fr).rstrip().splitlines():
+                lines.append("    " + ln)
+
+    tail = dump.get("log_lines") or []
+    if tail:
+        lines.append(f"-- log tail ({len(tail)} lines) --")
+        for ln in tail[-n_events:]:
+            lines.append("  " + str(ln))
+    return lines
+
+
+def diff_dumps(a: dict, b: dict, pa: str, pb: str) -> Tuple[List[str], int]:
+    """Structured diff; returns (lines, exit_code). Schema mismatch is the
+    only non-zero outcome — everything else is reported, not judged."""
+    lines: List[str] = [f"== post-mortem diff: {pa} vs {pb} =="]
+    sa, sb = a.get("schema"), b.get("schema")
+    if sa != sb:
+        lines.append(f"SCHEMA MISMATCH: {sa} vs {sb} — dumps not comparable")
+        return lines, 2
+    lines.append(f"schema: {sa} (both)")
+    ra, rb = a.get("reason"), b.get("reason")
+    lines.append(
+        f"reason: {ra} vs {rb}" + ("  [same]" if ra == rb else "  [DIFFERS]")
+    )
+    da, db = a.get("detail") or {}, b.get("detail") or {}
+    for k in sorted(set(da) | set(db)):
+        va, vb = da.get(k), db.get(k)
+        if va != vb:
+            lines.append(f"detail.{k}: {va!r} vs {vb!r}")
+
+    def tail_kinds(d: dict, n: int = 10) -> List[str]:
+        return [str(e.get("kind")) for e in (d.get("events") or [])[-n:]]
+
+    ka, kb = tail_kinds(a), tail_kinds(b)
+    if ka != kb:
+        lines.append(f"tail events: {ka} vs {kb}")
+    else:
+        lines.append(f"tail events: identical ({ka})")
+
+    ca, cb = _counter_totals(a), _counter_totals(b)
+    moved = sorted(
+        k for k in set(ca) | set(cb) if ca.get(k, 0.0) != cb.get(k, 0.0)
+    )
+    if moved:
+        lines.append("-- counter deltas (a vs b) --")
+        width = max(len(k) for k in moved)
+        for k in moved:
+            lines.append(
+                f"  {k:<{width}}  {ca.get(k, 0.0):g} vs {cb.get(k, 0.0):g}"
+            )
+    else:
+        lines.append("counters: identical")
+    return lines, 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="human summary of one dump")
+    r.add_argument("dump")
+    r.add_argument("--events", type=int, default=20,
+                   help="tail length for ring sections (default 20)")
+    d = sub.add_parser("diff", help="structured diff of two dumps")
+    d.add_argument("a")
+    d.add_argument("b")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == "render":
+            dump = load_dump(args.dump)
+            print("\n".join(render_dump(dump, args.dump, args.events)))
+            return 0
+        a, b = load_dump(args.a), load_dump(args.b)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    lines, rc = diff_dumps(a, b, args.a, args.b)
+    print("\n".join(lines))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,9 @@ Produces: a per-phase table (top-level spans, seconds, % of wall), a
 flamegraph-style text rendering of the span tree, a "== memory ==" table
 (per-phase peak RSS/device watermarks when the run sampled resources —
 obs schema >= 4), a "== work ==" table (the deterministic per-phase work
-ledger — obs schema >= 7), error events, and the metrics snapshot
+ledger — obs schema >= 7), an "== alerts ==" table (active SLO rules,
+raise/clear totals and the flight-recorder post-mortem path — obs schema
+>= 8), error events, and the metrics snapshot
 (bucketed histograms render p50/p99 estimates). --trace additionally
 renders the resource series as Perfetto counter tracks under the span
 lanes.
@@ -33,7 +35,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -442,6 +444,50 @@ def numerics(record: dict) -> str:
     return "\n".join(lines)
 
 
+def alerts(record: dict) -> str:
+    """SLO alert table (obs schema >= 8): the ``alerts`` block
+    obs/alerts.py stamps into the RunRecord — rules active at record time,
+    raise/clear totals, the most recent firing, plus the flight-recorder
+    post-mortem path when the run dumped one. Records written before
+    schema v8 render the placeholder line — absence is normal, never an
+    error (same contract as the serving/dispatch/work tables)."""
+    al = record.get("alerts") or {}
+    pm = record.get("postmortem_path")
+    if not al and not pm:
+        return "(no alert engine; schema < 8 record)"
+    lines: List[str] = []
+    active = al.get("active") or {}
+    if active:
+        lines.append(f"{'active rule':<28} {'value':>12} {'threshold':>12}")
+        for name in sorted(active):
+            info = active[name] or {}
+            v, th = info.get("value"), info.get("threshold")
+            lines.append(
+                f"{name:<28} "
+                f"{f'{v:.4g}' if v is not None else '-':>12} "
+                f"{f'{th:.4g}' if th is not None else '-':>12}"
+            )
+    else:
+        lines.append(f"{'active rules':<28} (none)")
+    for label, key in (
+        ("alerts raised", "raised_total"),
+        ("alerts cleared", "cleared_total"),
+    ):
+        if al.get(key) is not None:
+            lines.append(f"{label:<28} {al[key]:g}")
+    last = al.get("last_alert") or {}
+    if last:
+        lines.append(
+            f"{'last alert':<28} {last.get('name', '?')} "
+            f"(value={last.get('value')})"
+        )
+    if al.get("rules"):
+        lines.append(f"{'rules loaded':<28} {len(al['rules'])}")
+    if pm:
+        lines.append(f"{'post-mortem dump':<28} {pm}")
+    return "\n".join(lines)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -482,6 +528,7 @@ def render(record: dict) -> str:
         "", "== work ==", work(record),
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),
+        "", "== alerts ==", alerts(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]

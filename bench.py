@@ -257,6 +257,27 @@ def _work_ledger_block(tracer) -> dict:
     return _work_ledger_zero()
 
 
+# The lint rung (ISSUE 15): graftlint's summary travels on every payload so
+# perf history records whether the gate was green at measurement time. The
+# zero shape rides the failure rung (and any environment where the framework
+# itself can't run) — key-identical, like every other block.
+_LINT_ZERO = {"violations": 0, "baseline_size": 0, "rules_run": 0}
+
+
+def _lint_block() -> dict:
+    try:
+        from tools.graftlint import core as _glcore
+
+        res = _glcore.run(root=os.path.dirname(os.path.abspath(__file__)))
+        return {
+            "violations": len(res.violations),
+            "baseline_size": res.baseline_size,
+            "rules_run": len(res.rules_run),
+        }
+    except Exception:
+        return dict(_LINT_ZERO)
+
+
 # The wall-trials zero shape (failure rung; the default rung emits the real
 # block, other configs measure one wall and omit it).
 _WALL_TRIALS_ZERO = {
@@ -1331,6 +1352,7 @@ def main() -> None:
         payload["probe_s"] = probe_s
         payload["env_health"] = envh.block(probe_s)
         payload.setdefault("work_ledger", _work_ledger_zero())
+        payload.setdefault("lint", _lint_block())
         # configs that scoped their own flat window (the default rung's
         # headline-workload bracket) keep it; everything else gets the
         # historical process-wide delta
@@ -1406,6 +1428,7 @@ def main() -> None:
             "env_health": envh.block(probe_s),
             "wall_trials": dict(_WALL_TRIALS_ZERO),
             "work_ledger": _work_ledger_zero(),
+            "lint": dict(_LINT_ZERO),
             **_dispatch_delta(dispatch0, _dispatch_counters()),
             **_resource_rung(sampler),
             "obs_schema": _OBS_SCHEMA,

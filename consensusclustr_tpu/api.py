@@ -353,7 +353,7 @@ def _interactive_pc_num(norm, cfg: ClusterConfig, key, input_fn=input) -> Option
 
         plot_elbow(np.asarray(res.sdev), path="pca_elbow.png")
         where = " (elbow saved to pca_elbow.png)"
-    except Exception:
+    except Exception:  # graftlint: noqa[GL007] elbow plot is best-effort decoration of an interactive prompt
         where = ""
     answer = input_fn(f"Number of PCs to use{where} [enter = auto]: ").strip()
     try:

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 KNN_BLOCK = 1024
 
 
-@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def knn_points(
     x: jax.Array, k: int, block: int = KNN_BLOCK, compute_dtype: str = "float32"
 ) -> Tuple[jax.Array, jax.Array]:
@@ -49,7 +49,7 @@ def knn_points(
         cross = jnp.einsum("id,jd->ij", xc, xc, preferred_element_type=jnp.float32)
         d2 = sq[:, None] - 2.0 * cross + sq[None, :]
         d2 = jnp.maximum(d2, 0.0)
-        d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)  # exclude self
+        d2 = d2.at[jnp.arange(n, dtype=jnp.int32), jnp.arange(n, dtype=jnp.int32)].set(jnp.inf)  # exclude self
         neg, idx = jax.lax.top_k(-d2, k_eff)
     else:
         n_blocks = -(-n // block)
@@ -84,7 +84,7 @@ def knn_points(
     return idx.astype(jnp.int32), jnp.sqrt(jnp.maximum(-neg, 0.0))
 
 
-@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def knn_cross(
     query: jax.Array,
     ref: jax.Array,
@@ -176,13 +176,13 @@ def knn_candidates(
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k",))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def knn_from_distance(d: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN given a precomputed [n, n] distance matrix (the consensus
     Jaccard-distance path, reference :425)."""
     d = jnp.asarray(d, jnp.float32)
     n = d.shape[0]
-    d = d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    d = d.at[jnp.arange(n, dtype=jnp.int32), jnp.arange(n, dtype=jnp.int32)].set(jnp.inf)
     k_eff = min(k, n - 1)
     neg, idx = jax.lax.top_k(-d, k_eff)
     if k_eff < k:

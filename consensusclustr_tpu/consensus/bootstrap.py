@@ -21,22 +21,22 @@ import jax.numpy as jnp
 from consensusclustr_tpu.utils.rng import boot_key
 
 
-@functools.partial(jax.jit, static_argnames=("n", "nboots", "m"))
+@functools.partial(jax.jit, static_argnames=("n", "nboots", "m"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def bootstrap_indices(key: jax.Array, n: int, nboots: int, m: int) -> jax.Array:
     """[nboots, m] int32 cell indices, sampled uniformly with replacement."""
 
     def one(b):
         return jax.random.randint(boot_key(key, b), (m,), 0, n, dtype=jnp.int32)
 
-    return jax.vmap(one)(jnp.arange(nboots))
+    return jax.vmap(one)(jnp.arange(nboots, dtype=jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
+@functools.partial(jax.jit, static_argnames=("n",))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def sampled_mask(idx: jax.Array, n: int) -> jax.Array:
     """[.., n] bool: cell appears at least once in the resample."""
     shape = idx.shape[:-1] + (n,)
     flat = idx.reshape(-1, idx.shape[-1])
     out = jnp.zeros((flat.shape[0], n), bool)
-    rows = jnp.broadcast_to(jnp.arange(flat.shape[0])[:, None], flat.shape)
+    rows = jnp.broadcast_to(jnp.arange(flat.shape[0], dtype=jnp.int32)[:, None], flat.shape)
     out = out.at[rows, flat].set(True)
     return out.reshape(shape)

@@ -31,7 +31,7 @@ import numpy as np
 from consensusclustr_tpu.cluster.metrics import pairwise_rand
 
 
-@functools.partial(jax.jit, static_argnames=("max_clusters",))
+@functools.partial(jax.jit, static_argnames=("max_clusters",))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def cluster_mean_distance(
     dist: jax.Array, labels: jax.Array, max_clusters: int
 ) -> jax.Array:
@@ -40,7 +40,7 @@ def cluster_mean_distance(
     Empty clusters get +inf rows/cols."""
     lab = jnp.asarray(labels, jnp.int32)
     n = lab.shape[0]
-    onehot = (lab[:, None] == jnp.arange(max_clusters)[None, :]).astype(jnp.float32)
+    onehot = (lab[:, None] == jnp.arange(max_clusters, dtype=jnp.int32)[None, :]).astype(jnp.float32)
     counts = jnp.sum(onehot, axis=0)
     sums = onehot.T @ jnp.asarray(dist, jnp.float32) @ onehot          # [C, C]
     denom = jnp.outer(counts, counts)
@@ -72,7 +72,7 @@ def merge_small_clusters(
         labels[labels == smallest] = target
 
 
-@functools.partial(jax.jit, static_argnames=("max_clusters", "max_boot_clusters"))
+@functools.partial(jax.jit, static_argnames=("max_clusters", "max_boot_clusters"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def stability_matrix(
     consensus: jax.Array,
     boot_labels: jax.Array,
@@ -97,12 +97,12 @@ def stability_matrix(
     mean = jnp.nanmean(mats, axis=0)
     mean = jnp.where(jnp.isnan(mean), 1.0, mean)
     c = mean.shape[0]
-    return mean.at[jnp.arange(c), jnp.arange(c)].set(
+    return mean.at[jnp.arange(c, dtype=jnp.int32), jnp.arange(c, dtype=jnp.int32)].set(
         jnp.where(jnp.isnan(jnp.diagonal(mean)), 1.0, jnp.diagonal(mean))
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n_clusters",))
+@functools.partial(jax.jit, static_argnames=("n_clusters",))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def restricted_pair_stats(
     agree: jax.Array,     # [n, m] restricted agree counts
     union: jax.Array,     # [n, m] restricted union counts

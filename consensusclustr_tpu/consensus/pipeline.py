@@ -359,7 +359,7 @@ def run_bootstraps(
             rows_per_boot=rows_per_boot, metrics=mets, log=log,
         )
 
-    keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots))
+    keys = jax.vmap(lambda b: cluster_key(key, 50_000 + b))(jnp.arange(cfg.nboots, dtype=jnp.int32))
     depth = pipeline_depth(cfg.pipeline_depth)
     # one-time upload: the per-chunk jnp.asarray this replaces re-staged the
     # [n, d] matrix on every iteration when a caller passed a host array
@@ -406,7 +406,7 @@ def run_bootstraps(
                 lambda: ckpt.load_chunk(s2, size),
                 site=CKPT_READ_SITE, policy=rpol, metrics=mets, log=log,
             )
-        except Exception:
+        except Exception:  # graftlint: noqa[GL007] checkpoint read failure degrades to recompute; the retry layer already logged the attempts
             return None
 
     def _consume(ent):
@@ -553,7 +553,7 @@ def _consensus_grid_from_knn(
     for ki, k in enumerate(k_list):
         graph = snn_graph(knn_idx[:, :k], snn_impl=snn_impl)
         rev_dropped = rev_dropped + graph.rev_dropped
-        keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r))
+        keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r, dtype=jnp.int32))
 
         def one_res(kk, res):
             raw = community_detect(kk, graph, res, cluster_fun, n_iters=n_iters)

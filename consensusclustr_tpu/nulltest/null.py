@@ -143,7 +143,7 @@ def generate_null_statistics(
         if has_cov
         else jnp.zeros((n_cells, 1), jnp.float32)
     )
-    keys = jax.vmap(lambda s: sim_key(key, s, round_id))(jnp.arange(n_sims))
+    keys = jax.vmap(lambda s: sim_key(key, s, round_id))(jnp.arange(n_sims, dtype=jnp.int32))
     depth = pipeline_depth(pipeline_depth_override)
     mets = metrics_of(log)
     # null-chunk dispatch is a fault site (ISSUE 10): transient chunk

@@ -180,7 +180,7 @@ def _fp_jit():
     if _FP_JIT is None:
         import jax
 
-        _FP_JIT = jax.jit(fingerprint_scalars)
+        _FP_JIT = jax.jit(fingerprint_scalars)  # graftlint: noqa[GL004] fingerprint hashing deliberately runs outside the work ledger (obs must not perturb what it measures)
     return _FP_JIT
 
 
@@ -193,7 +193,7 @@ def _nonfinite_jit():
         def nf(x):
             return jnp.sum(~jnp.isfinite(x), dtype=jnp.int32)
 
-        _NF_JIT = jax.jit(nf)
+        _NF_JIT = jax.jit(nf)  # graftlint: noqa[GL004] fingerprint hashing deliberately runs outside the work ledger (obs must not perturb what it measures)
     return _NF_JIT
 
 

@@ -401,3 +401,197 @@ ALERT_RULES = frozenset({
     "retries_exhausted_rising", # retries_exhausted moved within the window
     "aot_fallbacks_rising",     # aot_fallbacks moved within the window
 })
+
+# ---------------------------------------------------------------------------
+# Environment-knob registry (ISSUE 15). Every ``CCTPU_*`` environment
+# variable read anywhere in consensusclustr_tpu/, bench.py, or tools/ must
+# have an entry here: (default-as-documented, one-line help). graftlint's
+# GL002 rule enforces the contract both directions — a knob read in code but
+# absent here fails lint, and a registered knob nothing reads fails lint —
+# and the docs/quirks.md knob table is GENERATED from this dict
+# (``python -m tools.graftlint --gen-env-docs``), so the 47-read-vs-19-
+# documented drift this registry was built to close cannot reopen.
+# Registering here is additive vocabulary, not a payload-shape change, so
+# SCHEMA_VERSION stays 8 (the ISSUE 9/10/13 non-bump precedent).
+ENV_KNOBS = {
+    "CCTPU_ALERT_P99_S": (
+        "30.0",
+        "serve_p99_high alert threshold: p99 serve latency bound, seconds.",
+    ),
+    "CCTPU_ALERT_REJECT_RATE": (
+        "0.05",
+        "serve_rejection_rate_high alert threshold: windowed reject fraction.",
+    ),
+    "CCTPU_AOT_CACHE_DIR": (
+        "~/.cache/consensusclustr_tpu/aot",
+        "Directory for serialized AOT serving executables (warm starts).",
+    ),
+    "CCTPU_BENCH_CPU_RETRY": (
+        "unset",
+        "Internal bench.py flag marking the forced-CPU retry child process.",
+    ),
+    "CCTPU_BENCH_PROBE_BUDGET": (
+        "240",
+        "bench.py TPU-probe wall budget in seconds before falling back to CPU.",
+    ),
+    "CCTPU_BENCH_PROBE_S": (
+        "0",
+        "Internal bench.py handoff: parent probe seconds, re-read by the child.",
+    ),
+    "CCTPU_BENCH_PROBE_VERDICT": (
+        "unset",
+        "Internal bench.py handoff: parent probe verdict, re-read by the child.",
+    ),
+    "CCTPU_CHUNK_BYTES": (
+        "6e9 on TPU, 2e9 on CPU",
+        "Consensus chunk-planner memory budget in bytes.",
+    ),
+    "CCTPU_CKPT_CHUNK": (
+        "32",
+        "Bootstrap checkpoint chunk: replicates per checkpointed segment.",
+    ),
+    "CCTPU_COMPILE_CACHE_DIR": (
+        "~/.cache/consensusclustr_tpu/xla",
+        "Directory for the persistent XLA compilation cache.",
+    ),
+    "CCTPU_DENSE_CONSENSUS_LIMIT": (
+        "16384",
+        "Max n for the dense [n, n] consensus path; larger runs go blockwise.",
+    ),
+    "CCTPU_FAULT_INJECT": (
+        "unset",
+        "Fault-injection spec 'site:kind[:arg][,...]' planted at FAULT_SITES.",
+    ),
+    "CCTPU_FORCE_CPU": (
+        "unset",
+        "Truthy pins JAX to the CPU backend before first device touch.",
+    ),
+    "CCTPU_GRID_IMPL": (
+        "fused",
+        "Boot fan-out program: 'fused' (vmapped-k) or 'looped' (parity oracle).",
+    ),
+    "CCTPU_LOG_LEVEL": (
+        "WARNING",
+        "Package logger level (name or int) for the consensusclustr logger.",
+    ),
+    "CCTPU_MAX_CHUNK": (
+        "8 on TPU, 64 elsewhere",
+        "Consensus chunk-planner cap on replicates per chunk.",
+    ),
+    "CCTPU_NO_AOT_CACHE": (
+        "unset",
+        "Truthy disables the on-disk AOT serving-executable cache.",
+    ),
+    "CCTPU_NO_COMPILE_CACHE": (
+        "unset",
+        "Truthy disables the persistent XLA compilation cache.",
+    ),
+    "CCTPU_NO_COST_ANALYSIS": (
+        "unset",
+        "Truthy skips XLA cost analysis in counting_jit (flops/bytes attrs).",
+    ),
+    "CCTPU_NO_FLIGHT": (
+        "unset",
+        "Truthy disables the flight recorder (no post-mortem dumps).",
+    ),
+    "CCTPU_NO_PALLAS": (
+        "unset",
+        "Truthy kill switch: force XLA fallbacks over all Pallas kernels.",
+    ),
+    "CCTPU_NUMERICS": (
+        "off",
+        "Numerics-fingerprint level: off, light, or paranoid checkpoints.",
+    ),
+    "CCTPU_NUMERICS_INJECT": (
+        "unset",
+        "Numeric-drift injection spec 'bf16:<checkpoint>' for parity audits.",
+    ),
+    "CCTPU_PALLAS_INTERPRET": (
+        "unset",
+        "Truthy runs Pallas kernels in interpret mode (CPU-debuggable).",
+    ),
+    "CCTPU_PALLAS_VARIANT": (
+        "mxu",
+        "Cocluster Pallas kernel variant: 'mxu' (dot-general) or 'vpu'.",
+    ),
+    "CCTPU_PIPELINE_DEPTH": (
+        "2",
+        "Double-buffered bootstrap pipeline depth (in-flight chunk count).",
+    ),
+    "CCTPU_POSTMORTEM_DIR": (
+        "unset",
+        "Directory for timestamped flight-recorder post-mortem dumps.",
+    ),
+    "CCTPU_POSTMORTEM_PATH": (
+        "unset",
+        "Exact file path for the flight-recorder post-mortem dump.",
+    ),
+    "CCTPU_RESOURCE_MAX_SAMPLES": (
+        "4096",
+        "Ring-buffer cap on retained resource samples (trace stream).",
+    ),
+    "CCTPU_RESOURCE_SAMPLE_MS": (
+        "off",
+        "Resource-sampler period in ms; 0/off/none disables (the default).",
+    ),
+    "CCTPU_RETRY_ATTEMPTS": (
+        "3",
+        "Max attempts per fault site before retries_exhausted surfaces.",
+    ),
+    "CCTPU_RETRY_BASE_S": (
+        "0.02",
+        "Base backoff delay in seconds (exponential, jittered, capped).",
+    ),
+    "CCTPU_RETRY_DEADLINE_S": (
+        "unset",
+        "Optional wall deadline in seconds across all attempts at a site.",
+    ),
+    "CCTPU_RUN_RECORD": (
+        "unset",
+        "Path to write the per-run provenance record JSON (api.run_record).",
+    ),
+    "CCTPU_SERVE_BUCKETS": (
+        "powers of two up to max batch",
+        "Comma-separated compiled batch-bucket ladder for serving.",
+    ),
+    "CCTPU_SERVE_MAX_BATCH": (
+        "256",
+        "Largest serving micro-batch (top of the bucket ladder).",
+    ),
+    "CCTPU_SERVE_METRICS_PORT": (
+        "off",
+        "Serving /metrics + /healthz port; 0 = ephemeral, off/none = no socket.",
+    ),
+    "CCTPU_SERVE_QUEUE_DEPTH": (
+        "64",
+        "Serving admission-queue depth; beyond it requests are rejected.",
+    ),
+    "CCTPU_SERVE_WORKER_RESTARTS": (
+        "16",
+        "Worker-supervisor restart budget before the service fails all.",
+    ),
+    "CCTPU_SHARDED_PALLAS": (
+        "unset",
+        "'1' enables the per-shard Pallas cocluster path under pmap.",
+    ),
+    "CCTPU_SNN_IMPL": (
+        "pallas on TPU, jax elsewhere",
+        "SNN rank-scan backend: 'pallas' (fused kernel) or 'jax' (scan build).",
+    ),
+    "CCTPU_SPAN_ANNOTATE": (
+        "unset",
+        "Truthy mirrors obs spans into jax.profiler trace annotations.",
+    ),
+    "CCTPU_STALL_FACTOR": (
+        "8.0",
+        "Stall-watchdog deadline multiplier over the observed p99.",
+    ),
+    "CCTPU_STALL_FLOOR_S": (
+        "120.0",
+        "Stall-watchdog minimum deadline in seconds (cold-start floor).",
+    ),
+    "CCTPU_SWEEP_MAX": (
+        "8",
+        "tools/tpu_chunk_sweep.py ceiling on the swept chunk sizes.",
+    ),
+}

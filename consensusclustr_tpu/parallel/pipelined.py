@@ -104,7 +104,7 @@ class AsyncChunkWriter:
             if self._error is None:  # after an error, drain without writing
                 try:
                     fn(*args, **kwargs)
-                except BaseException as e:  # latched, re-raised on the host thread
+                except BaseException as e:  # latched, re-raised on the host thread  # graftlint: noqa[GL007] error latched and re-raised on the host thread by _raise_pending
                     self._error = e
 
     def _raise_pending(self) -> None:
@@ -316,7 +316,7 @@ class ChunkPipeline:
                     import jax
 
                     jax.block_until_ready(ent._payload)
-                except Exception:
+                except Exception:  # graftlint: noqa[GL007] best-effort drain during teardown; the latched error already propagated
                     pass
                 ent._payload = None
                 ent._fetched = True

@@ -164,6 +164,6 @@ def retry_call(
             RETRIES_FLIGHT, log=log, site=site, attempts=attempt,
             error=type(last).__name__,
         )
-    except Exception:
+    except Exception:  # graftlint: noqa[GL007] flight-recorder dump is best-effort in the crash path; the original error re-raises on the next line
         pass
     raise last

@@ -232,7 +232,7 @@ class ReferenceArtifact:
             "level_tables": self.level_tables,
             "libsize_mean": float(self.libsize_mean),
             "stability_source": self.stability_source,
-            "created_unix": time.time(),
+            "created_unix": time.time(),  # graftlint: noqa[GL006] deliberate provenance timestamp in the export manifest, never read back into numerics
             "config_fingerprint": fingerprint,
             "config": snapshot,
         }

@@ -122,7 +122,7 @@ def _assign_batch(
     idx, dist = knn_cross(proj, ref_emb, k_eff)                 # [q, k_eff]
     codes_nb = ref_codes[idx]                                   # [q, k_eff]
 
-    onehot = (codes_nb[:, :, None] == jnp.arange(n_classes)[None, None, :])
+    onehot = (codes_nb[:, :, None] == jnp.arange(n_classes, dtype=jnp.int32)[None, None, :])
     votes = jnp.sum(onehot.astype(jnp.float32), axis=1)         # [q, C]
     winner = jnp.argmax(votes, axis=1).astype(jnp.int32)
     frac = jnp.take_along_axis(votes, winner[:, None], axis=1)[:, 0] / k_eff

@@ -102,7 +102,7 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
                 cost = jitted.lower(*sds[0], **sds[1]).cost_analysis()
             finally:
                 in_harvest[0] = False
-        except Exception:
+        except Exception:  # graftlint: noqa[GL007] cost analysis is an optional metric source, never a requirement
             return
         mets = global_metrics()
         for entry in cost if isinstance(cost, (list, tuple)) else (cost,):
@@ -132,13 +132,13 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
             mets.counter("donated_bytes").inc(nbytes)
         try:
             size_before = jitted._cache_size()
-        except Exception:
+        except Exception:  # graftlint: noqa[GL007] cache-size introspection uses private jax API; absence just skips the compile counter
             size_before = None
         out = jitted(*args, **kwargs)
         if size_before is not None:
             try:
                 fresh_compile = jitted._cache_size() > size_before
-            except Exception:
+            except Exception:  # graftlint: noqa[GL007] cache-size introspection uses private jax API; absence just skips the compile counter
                 fresh_compile = False
             if fresh_compile:
                 _harvest_cost(args, kwargs)
@@ -194,7 +194,7 @@ def enable_persistent_cache() -> bool:
             mets.gauge("compile_cache_entries").set(len(os.listdir(cache_dir)))
         except OSError:
             pass
-    except Exception:
+    except Exception:  # graftlint: noqa[GL007] persistent compile cache is an optimisation, never a requirement
         pass  # cache is an optimisation, never a requirement
     mets.gauge("compile_cache_enabled").set(1 if enabled else 0)
     _done = True
@@ -297,7 +297,7 @@ def aot_save(key: str, compiled) -> Optional[str]:
         os.replace(tmp, path)
         global_metrics().counter("aot_cache_saves").inc()
         return path
-    except Exception:
+    except Exception:  # graftlint: noqa[GL007] AOT cache save is best-effort; a failed save costs a recompile, not a run
         return None
 
 

@@ -104,7 +104,7 @@ def _jsonable(x: Any):
             return float(x)
         if isinstance(x, np.ndarray):
             return x.tolist()
-    except Exception:
+    except Exception:  # graftlint: noqa[GL007] JSON sanitizer fallback: logging about a logging failure would recurse
         pass
     return str(x)
 

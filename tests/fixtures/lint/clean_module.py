@@ -1,0 +1,23 @@
+"""A fixture with no violations: every draw pinned, knobs threaded, errors
+logged. Never imported, only parsed."""
+
+import jax
+import jax.numpy as jnp
+
+log = None
+
+
+def resolve_widget(value=None):
+    return value or "default"
+
+
+def well_behaved(key, n, widget=None):
+    impl = resolve_widget(widget)
+    noise = jax.random.uniform(key, (n,), jnp.float32)
+    base = jnp.zeros((n,), jnp.float32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    try:
+        return base.at[idx].add(noise), impl
+    except Exception:
+        log.warning("scatter failed")
+        raise

@@ -1,0 +1,33 @@
+"""Suppression-semantics fixture (ISSUE 15 satellite): one file exercising
+every noqa shape. tests/test_graftlint.py locates each case by its source text. Never imported, only parsed."""
+
+import jax
+import jax.numpy as jnp
+import time
+
+
+def suppressed_ok(key, shape):
+    # a correct suppression: named code + reason -> silenced
+    return jax.random.uniform(key, shape)  # graftlint: noqa[GL003] fixture: dtype-polymorphic helper
+
+def bare_noqa(key, shape):
+    # bare marker: suppresses nothing AND is itself a GL000
+    return jax.random.uniform(key, shape)  # graftlint: noqa
+
+def reasonless_noqa(key, shape):
+    # named code but no reason: GL000, and GL003 still fires
+    return jax.random.uniform(key, shape)  # graftlint: noqa[GL003]
+
+def wrong_code_noqa(key, shape):
+    # suppression is per-code: GL006 noqa does not silence GL003
+    return jax.random.uniform(key, shape)  # graftlint: noqa[GL006] fixture: wrong code on purpose
+
+def wrong_line_noqa(key, shape):
+    # suppression is per-line: a noqa one line away silences nothing
+    # graftlint: noqa[GL003] fixture: comment-only line, not the call line
+    return jax.random.uniform(key, shape)
+
+def multi_code_ok():
+    # one comment may name several codes
+    t = jnp.zeros(int(time.time()))  # graftlint: noqa[GL003,GL006] fixture: both codes silenced at once
+    return t

@@ -1,0 +1,29 @@
+"""PR 10 regression fixture: the resolved-but-unused CCTPU_GRID_IMPL bug,
+verbatim shape. resolve_grid_impl's result was bound and then the fused
+program dispatched unconditionally — the parity audit silently compared
+fused against fused. graftlint must flag the marked line as GL005. Never
+imported — only parsed by the linter."""
+
+
+def resolve_grid_impl(value=None):
+    return value or "fused"
+
+
+def _fused_program(embeddings):
+    return embeddings
+
+
+def boot_batch(embeddings, grid_impl=None):
+    # the PR 10 bug, as shipped: resolved, validated... ignored
+    impl = resolve_grid_impl(grid_impl)
+    return _fused_program(embeddings)
+
+
+def fixed_boot_batch(embeddings, grid_impl=None):
+    impl = resolve_grid_impl(grid_impl)
+    program = _fused_program if impl == "fused" else _looped_program
+    return program(embeddings)
+
+
+def _looped_program(embeddings):
+    return embeddings

@@ -1,0 +1,18 @@
+"""PR 8 regression fixture: the x64 dtype-widening jitter bug, verbatim
+shape. The unpinned uniform draw on the marked line defaulted to float64
+under jax_enable_x64 and changed Leiden tie-breaks. graftlint must flag it
+as GL003 at exactly that line. Never imported — only parsed by the linter."""
+
+import jax
+import jax.numpy as jnp
+
+
+def tie_break_jitter(key, gain):
+    # the PR 8 bug, as shipped: no dtype (tests locate this line by its text)
+    noise = jax.random.uniform(key, gain.shape)
+    return gain + 1e-6 * noise
+
+
+def fixed_tie_break_jitter(key, gain):
+    noise = jax.random.uniform(key, gain.shape, jnp.float32)
+    return gain + 1e-6 * noise

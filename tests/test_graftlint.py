@@ -1,0 +1,403 @@
+"""ISSUE 15 — graftlint: the pluggable JAX-aware static-analysis framework.
+
+Covers the tentpole end to end:
+
+* framework core: rule registry (stable GL0xx codes, --explain catalog and
+  per-rule docs), text/JSON output, bench_diff exit-code convention
+  (0 clean / 1 usage / 3 violations);
+* the two historical regressions as executable fixtures: the PR 8
+  unpinned-dtype jitter bug must trip GL003 and the PR 10
+  resolved-but-unused CCTPU_GRID_IMPL bug must trip GL005, each at the
+  exact line, each driving exit code 3 through the real CLI;
+* suppression semantics (tests/fixtures/lint/noqa_semantics.py): a
+  noqa-with-reason silences exactly one code on exactly one line; bare and
+  reasonless noqas are GL000 hygiene violations that suppress nothing;
+  wrong-code and wrong-line noqas suppress nothing; multi-code noqas work;
+* baseline semantics: grandfathered findings are reported separately and
+  do not fail the run; a stale entry (fixed finding still listed) is a
+  GL000 violation;
+* the tier-1 gate: the full framework over the real package with the
+  committed baseline must exit 0 — the repo itself stays lint-clean;
+* GL002 env-knob registry: every CCTPU_* read <-> obs.schema.ENV_KNOBS
+  both directions, and the generated docs/quirks.md table is current
+  (--gen-env-docs is idempotent over the committed tree);
+* the check_obs_schema.py thin wrapper keeps its exact import surface,
+  CLI output and exit codes;
+* bench.py's lint block (key-identical zero shape on the failure rung)
+  and tools/report.py's "== lint ==" section.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _line_of(path: str, needle: str) -> int:
+    """1-based line of the first source line containing ``needle``."""
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if needle in line:
+                return i
+    raise AssertionError(f"{needle!r} not found in {path}")
+
+
+def _cli(*argv, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *argv],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+    )
+
+
+def _run_fixture(name, select=None, baseline_path=None):
+    return core.run(
+        root=REPO_ROOT, paths=[_fixture(name)], select=select,
+        baseline_path=baseline_path,
+    )
+
+
+class TestFramework:
+    def test_registry_codes_and_catalog(self):
+        rules = core.all_rules()
+        assert set(rules) == {
+            "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        }
+        catalog = core.explain()
+        for code, rule in rules.items():
+            assert code in catalog
+            assert rule.name in catalog
+            assert rule.__class__.__doc__, f"{code} has no docstring"
+        assert "GL000" in catalog  # the built-in hygiene meta-rule
+
+    def test_explain_single_rule_renders_docstring(self):
+        text = core.explain("GL003")
+        assert "GL003" in text and "PR 8" in text and "dtype" in text
+
+    def test_explain_unknown_code(self):
+        with pytest.raises(KeyError):
+            core.explain("GL999")
+
+    def test_exit_codes_match_bench_diff_convention(self):
+        clean = _run_fixture("clean_module.py")
+        assert clean.exit_code == 0 and not clean.violations
+        dirty = _run_fixture("pr8_regression.py", select=["GL003"])
+        assert dirty.exit_code == 3
+        usage = core.run(root=REPO_ROOT, paths=[], select=["GL999"])
+        assert usage.exit_code == 1 and usage.errors
+
+    def test_json_output_shape(self):
+        p = _cli("--json", "--no-baseline", "--select", "GL003",
+                 _fixture("pr8_regression.py"))
+        data = json.loads(p.stdout)
+        assert data["tool"] == "graftlint"
+        assert data["rules_run"] == ["GL003"]
+        assert data["violations"] and data["violations"][0]["code"] == "GL003"
+        assert {"path", "line", "message", "severity"} <= set(
+            data["violations"][0]
+        )
+
+    def test_duplicate_rule_code_rejected(self):
+        with pytest.raises(ValueError):
+            @core.register
+            class Dup(core.Rule):
+                code = "GL003"
+                name = "dup"
+
+
+class TestHistoricalRegressions:
+    """The acceptance criteria: each historical bug trips its rule at the
+    right line and drives exit 3 through the real CLI."""
+
+    def test_pr8_unpinned_dtype_trips_gl003(self):
+        path = _fixture("pr8_regression.py")
+        want = _line_of(path, "jax.random.uniform(key, gain.shape)")
+        p = _cli("--no-baseline", path)
+        assert p.returncode == 3, p.stdout + p.stderr
+        assert f"pr8_regression.py:{want}: GL003" in p.stdout
+        # the fixed variant (dtype pinned positionally) is not flagged
+        fixed = _line_of(path, "jnp.float32)")
+        assert f"pr8_regression.py:{fixed}:" not in p.stdout
+
+    def test_pr10_resolve_unused_trips_gl005(self):
+        path = _fixture("pr10_regression.py")
+        want = _line_of(path, "impl = resolve_grid_impl(grid_impl)")
+        p = _cli("--no-baseline", path)
+        assert p.returncode == 3, p.stdout + p.stderr
+        assert f"pr10_regression.py:{want}: GL005" in p.stdout
+        # exactly one GL005: the fixed variant reads impl and is clean
+        assert p.stdout.count("GL005") == 1
+
+
+class TestNoqaSemantics:
+    PATH = "noqa_semantics.py"
+
+    def _res(self):
+        return _run_fixture(self.PATH)
+
+    def _lines(self, res, code):
+        return [f.line for f in res.violations if f.code == code]
+
+    def test_noqa_with_reason_silences(self):
+        res = self._res()
+        ok_line = _line_of(_fixture(self.PATH), "dtype-polymorphic helper")
+        assert ok_line not in self._lines(res, "GL003")
+        assert any(
+            f.line == ok_line and f.code == "GL003" for f in res.suppressed
+        )
+
+    def test_bare_noqa_is_gl000_and_suppresses_nothing(self):
+        res = self._res()
+        bare = _line_of(_fixture(self.PATH), "# graftlint: noqa\n")
+        assert bare in self._lines(res, "GL000")
+        assert bare in self._lines(res, "GL003")
+
+    def test_reasonless_noqa_is_gl000_and_suppresses_nothing(self):
+        res = self._res()
+        line = _line_of(_fixture(self.PATH), "noqa[GL003]\n")
+        assert line in self._lines(res, "GL000")
+        assert line in self._lines(res, "GL003")
+
+    def test_wrong_code_noqa_does_not_silence(self):
+        res = self._res()
+        line = _line_of(_fixture(self.PATH), "wrong code on purpose")
+        assert line in self._lines(res, "GL003")
+
+    def test_wrong_line_noqa_does_not_silence(self):
+        res = self._res()
+        comment = _line_of(
+            _fixture(self.PATH), "comment-only line, not the call line"
+        )
+        assert comment + 1 in self._lines(res, "GL003")
+
+    def test_multi_code_noqa_silences_both(self):
+        res = self._res()
+        line = _line_of(_fixture(self.PATH), "both codes silenced at once")
+        assert line not in self._lines(res, "GL003")
+        assert line not in self._lines(res, "GL006")
+        codes_suppressed = {
+            f.code for f in res.suppressed if f.line == line
+        }
+        assert codes_suppressed == {"GL003", "GL006"}
+
+    def test_gl000_is_not_suppressible(self):
+        # a noqa naming GL000 earns a hygiene finding instead of working
+        res = self._res()
+        assert all(f.code != "GL000" for f in res.suppressed)
+
+
+class TestBaseline:
+    def _finding_entries(self):
+        res = _run_fixture("pr8_regression.py", select=["GL003"])
+        assert res.violations
+        return [
+            {"code": f.code, "path": f.path, "message": f.message}
+            for f in res.violations
+        ]
+
+    def test_baselined_findings_do_not_fail(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps(
+            {"version": 1, "entries": self._finding_entries()}
+        ))
+        res = _run_fixture(
+            "pr8_regression.py", select=["GL003"], baseline_path=str(bl)
+        )
+        assert res.exit_code == 0
+        assert res.baselined and not res.violations
+        assert res.baseline_size == len(self._finding_entries())
+
+    def test_stale_baseline_entry_is_reported(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        entries = self._finding_entries() + [{
+            "code": "GL003", "path": "tests/fixtures/lint/pr8_regression.py",
+            "message": "a finding that was fixed long ago",
+        }]
+        bl.write_text(json.dumps({"version": 1, "entries": entries}))
+        res = _run_fixture(
+            "pr8_regression.py", select=["GL003"], baseline_path=str(bl)
+        )
+        assert res.exit_code == 3
+        stale = [f for f in res.violations if f.code == "GL000"]
+        assert len(stale) == 1
+        assert "stale baseline entry" in stale[0].message
+        assert "fixed long ago" in stale[0].message
+
+    def test_malformed_baseline_is_usage_error(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text("{not json")
+        res = _run_fixture("clean_module.py", baseline_path=str(bl))
+        assert res.exit_code == 1 and res.errors
+
+    def test_write_baseline_roundtrip(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        res = _run_fixture("pr8_regression.py", select=["GL003"])
+        core.write_baseline(str(bl), res.violations)
+        data = json.loads(bl.read_text())
+        assert data["version"] == 1 and data["entries"]
+        res2 = _run_fixture(
+            "pr8_regression.py", select=["GL003"], baseline_path=str(bl)
+        )
+        assert res2.exit_code == 0
+
+
+class TestTier1Gate:
+    """The repo itself stays lint-clean against the committed baseline."""
+
+    def test_package_is_clean_with_committed_baseline(self):
+        res = core.run(root=REPO_ROOT)
+        rendered = "\n".join(f.render() for f in res.violations)
+        assert res.exit_code == 0, f"graftlint violations:\n{rendered}"
+        assert res.files_scanned > 50
+        assert res.rules_run == sorted(core.all_rules())
+
+    def test_cli_exits_zero_over_the_package(self):
+        p = _cli()
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "graftlint: clean" in p.stdout
+
+
+class TestEnvKnobRegistry:
+    def test_env_knobs_complete_both_directions(self):
+        from tools.graftlint.rules import env_knobs
+        from consensusclustr_tpu.obs import schema
+
+        reads = env_knobs.scan_knob_reads(REPO_ROOT)
+        assert set(reads) == set(schema.ENV_KNOBS), (
+            "code reads vs ENV_KNOBS drift: "
+            f"unregistered={sorted(set(reads) - set(schema.ENV_KNOBS))} "
+            f"ghost={sorted(set(schema.ENV_KNOBS) - set(reads))}"
+        )
+        for name, (default, help_text) in schema.ENV_KNOBS.items():
+            assert str(help_text).strip(), f"{name} has empty help"
+
+    def test_known_historical_knobs_are_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        # the PR 8 / PR 10 actors plus a spread across the subsystems
+        for knob in ("CCTPU_GRID_IMPL", "CCTPU_SNN_IMPL", "CCTPU_NO_PALLAS",
+                     "CCTPU_FAULT_INJECT", "CCTPU_SERVE_METRICS_PORT",
+                     "CCTPU_NUMERICS", "CCTPU_FORCE_CPU"):
+            assert knob in schema.ENV_KNOBS
+
+    def test_docs_table_is_current(self):
+        from tools.graftlint.rules import env_knobs
+
+        path = os.path.join(REPO_ROOT, "docs", "quirks.md")
+        text = open(path, encoding="utf-8").read()
+        loc = env_knobs._current_section(text)
+        assert loc is not None, "docs/quirks.md lost its generated table"
+        assert loc[2] == env_knobs.render_env_table()
+
+    def test_gen_env_docs_idempotent(self):
+        p = _cli("--gen-env-docs")
+        assert p.returncode == 0
+        assert "already current" in p.stdout
+
+
+class TestCheckObsSchemaWrapper:
+    """The thin wrapper keeps its import surface and CLI contract."""
+
+    def _load(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_obs_schema",
+            os.path.join(REPO_ROOT, "tools", "check_obs_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_import_surface(self):
+        mod = self._load()
+        for attr in ("check", "check_help_registry", "check_resource_attrs",
+                     "check_consensus_attrs", "check_fault_sites",
+                     "check_work_ledger", "check_snn_impls",
+                     "check_flight_alerts", "_py_files", "SCAN", "schema",
+                     "main"):
+            assert hasattr(mod, attr), attr
+
+    def test_cli_clean_exit_zero(self):
+        p = subprocess.run(
+            [sys.executable, os.path.join("tools", "check_obs_schema.py")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "obs schema clean" in p.stdout
+
+    def test_cli_violation_exit_one(self, tmp_path):
+        # a synthetic tree with one bad event literal: exit 1, legacy output
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text('log.event("nope_not_registered")\n')
+        p = subprocess.run(
+            [sys.executable, os.path.join("tools", "check_obs_schema.py"),
+             str(tmp_path)],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert p.returncode == 1
+        assert "1 schema violation(s)" in p.stdout
+        assert "nope_not_registered" in p.stdout
+
+    def test_gl001_reports_same_findings_as_wrapper(self, tmp_path):
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text('log.event("nope_not_registered")\n')
+        res = core.run(
+            root=str(tmp_path), select=["GL001"], baseline_path=None
+        )
+        assert res.exit_code == 3
+        assert any(
+            "nope_not_registered" in f.message and f.code == "GL001"
+            for f in res.violations
+        )
+
+
+class TestBenchAndReportWiring:
+    def _load_bench(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO_ROOT, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_lint_zero_shape_matches_real_block(self):
+        bench = self._load_bench()
+        real = bench._lint_block()
+        assert set(bench._LINT_ZERO) == set(real) == {
+            "violations", "baseline_size", "rules_run",
+        }
+        assert all(v == 0 for v in bench._LINT_ZERO.values())
+        # over the committed tree the real block is green and non-trivial
+        assert real["violations"] == 0
+        assert real["rules_run"] == len(core.all_rules())
+
+    def test_report_lint_section(self):
+        spec = importlib.util.spec_from_file_location(
+            "report", os.path.join(REPO_ROOT, "tools", "report.py")
+        )
+        report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(report)
+        line = report.lint(
+            {"lint": {"violations": 2, "baseline_size": 1, "rules_run": 7}}
+        )
+        assert "violations=2" in line and "baseline=1" in line
+        assert report.lint({}) == "(no lint block)"
+        rec = {"schema": 8, "events": [], "spans": [], "metrics": {}}
+        out = report.render(dict(rec, lint={
+            "violations": 0, "baseline_size": 0, "rules_run": 7,
+        }))
+        assert "== lint ==" in out and "violations=0" in out

@@ -1,508 +1,59 @@
 #!/usr/bin/env python
 """Static observability-schema check (invoked from the tier-1 suite).
 
-Scans the package sources (and bench.py) for literal event/span/metric names:
+Since ISSUE 15 this is a thin wrapper: the nine registry checks that grew
+here across ISSUEs 1-14 live in ``tools/graftlint/rules/schema_registry.py``
+as graftlint's GL001 rule family (run the full framework with
+``python -m tools.graftlint``; ``--explain GL001`` documents the contract).
+This module re-exports every check function, regex and the ``SCAN`` tuple
+unchanged, and keeps the exact CLI and exit-code contract external callers
+and the tier-1 tests rely on:
 
-    log.event("boots", ...)          -> obs.schema.EVENT_KINDS
-    tracer.span("cocluster")         -> obs.schema.SPAN_NAMES
-    maybe_span(log, "null_test")     -> obs.schema.SPAN_NAMES
-    metrics.counter("boots_completed") / .gauge("queue_depth")
-        / .histogram("serve_latency_seconds")
-                                     -> obs.schema.METRIC_NAMES
+    python tools/check_obs_schema.py [root]
+    # exit 0, "obs schema clean"           when the registries agree
+    # exit 1, each violation + "N schema violation(s)" otherwise
 
-and fails on any name missing from the registry — a typo'd metric name
-becomes a test failure instead of a silently absent time series. All three
-instrument kinds (counter/gauge/histogram literals) are scanned; the package
-walk covers every subpackage including obs/export.py and serve/. Dynamic
-(non-literal) names are out of scope by design; the registry covers the
-package's own instrumentation, which is all literal.
-
-Since ISSUE 4 the registry also carries per-metric help text
-(``obs.schema.METRIC_HELP`` — the Prometheus # HELP lines): this check fails
-when METRIC_HELP and METRIC_NAMES drift apart, so every exported series is
-documented and no documented series is unregistered.
-
-Since ISSUE 6 the check also walks ``obs/resource.py``'s span-attr literals
-(the ``RSS_PEAK_ATTR = "rss_peak_bytes"``-style module constants the
-ResourceSampler stamps on closing spans) against
-``obs.schema.RESOURCE_SPAN_ATTRS``, both directions — a renamed watermark
-attr is a test failure, not a silently empty "== memory ==" table in
-tools/report.py.
-
-Since ISSUE 8 the same treatment covers the numerics layer:
-``obs/fingerprint.py``'s ``*_CKPT`` constants <->
-``obs.schema.NUMERIC_CHECKPOINTS`` and its ``*_ATTR`` constants <->
-``obs.schema.NUMERIC_SPAN_ATTRS`` (both directions), literal
-``numeric_checkpoint(log, "...")`` call-site names anywhere in the scanned
-trees, and ``tools/parity_audit.py``'s checkpoint/metric/event literals — a
-renamed checkpoint is a test failure, not a parity audit that silently
-stops covering a pipeline stage.
-
-Since ISSUE 9 the same both-directions treatment covers the consensus-regime
-provenance: ``consensus/pipeline.py``'s ``*_ATTR`` constants (the regime /
-candidate_m / accumulated_pairs / pairs_ratio attrs on the candidates and
-cocluster spans) <-> ``obs.schema.CONSENSUS_SPAN_ATTRS``.
-
-Since ISSUE 10 it also covers the resilience layer:
-``resilience/inject.py``'s ``*_SITE`` constants <->
-``obs.schema.FAULT_SITES`` (both directions — every registered fault site
-must have a defining constant, every constant must be registered), and
-``tools/chaos_audit.py``'s site literals must be registered (not complete —
-the auditor consumes sites, it defines none). A renamed site is a test
-failure, not a chaos audit that silently stops covering a failure mode. The
-new retry/quarantine/supervision metric names ride the existing
-METRIC_HELP <-> METRIC_NAMES walk.
-
-Since ISSUE 12 the work ledger rides the same rails: ``obs/ledger.py``'s
-``*_WORK`` constants <-> ``obs.schema.WORK_LEDGER_COUNTERS`` (both
-directions), the registry pinned as a subset of METRIC_NAMES, and the
-import-failure fallback literals in bench.py (``_DISPATCH_FALLBACK`` /
-``_LEDGER_FALLBACK``) plus tools/perf_history.py's ``FLAT_LEDGER_KEYS``
-ast-pinned to obs.ledger — the bench failure payload must stay
-key-identical to real rungs even when the package cannot import.
-
-Since ISSUE 14 the failure layer rides the same rails:
-``obs/alerts.py``'s ``*_ALERT`` constants <-> ``obs.schema.ALERT_RULES``
-and ``obs/flight.py``'s ``*_FLIGHT`` constants <->
-``obs.schema.FLIGHT_EVENT_KINDS`` (both directions — every registered
-rule/dump-reason must have a defining constant, every constant must be
-registered), while ``serve/service.py`` and the cross-module consumers
-(flight.py's ``*_ALERT`` uses, alerts.py's ``*_FLIGHT`` uses) are held to
-registered-only — same contract as FAULT_SITES. A renamed alert rule is a
-test failure, not a dashboard paging on a series that no longer exists.
-
-Usage: python tools/check_obs_schema.py [repo_root]
-Exit 0 = clean; 1 = violations (printed one per line).
+The heavy lifting — what is checked and why — is documented in
+schema_registry's module docstring, which this wrapper's historical
+docstring collapsed into.
 """
 
-from __future__ import annotations
-
 import os
-import re
 import sys
-from typing import List
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_ROOT = os.path.dirname(_HERE)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-from consensusclustr_tpu.obs import schema  # noqa: E402
-
-EVENT_RE = re.compile(r"""\.event\(\s*["']([A-Za-z0-9_]+)["']""")
-SPAN_RE = re.compile(r"""\.span\(\s*["']([A-Za-z0-9_]+)["']""")
-MAYBE_SPAN_RE = re.compile(
-    r"""maybe_span\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
+from tools.graftlint.rules.schema_registry import (  # noqa: E402,F401
+    ALERT_RE,
+    ATTR_RE,
+    CKPT_CALL_RE,
+    CKPT_RE,
+    EVENT_RE,
+    FLIGHT_RE,
+    MAYBE_SPAN_RE,
+    METRIC_RE,
+    SCAN,
+    SITE_RE,
+    SITE_SPEC_RE,
+    SNN_IMPL_RE,
+    SPAN_RE,
+    WORK_RE,
+    _literal_assign,
+    _py_files,
+    _scan_constants,
+    check,
+    check_consensus_attrs,
+    check_fault_sites,
+    check_flight_alerts,
+    check_help_registry,
+    check_numeric_registry,
+    check_resource_attrs,
+    check_snn_impls,
+    check_work_ledger,
+    schema,
 )
-METRIC_RE = re.compile(
-    r"""\.(counter|gauge|histogram)\(\s*["']([A-Za-z0-9_]+)["']"""
-)
-# obs/resource.py + obs/fingerprint.py span-attr constants:
-# NAME_ATTR = "literal" at module level
-ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""")
-# obs/fingerprint.py checkpoint-name constants: NAME_CKPT = "literal"
-CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""")
-# resilience/inject.py fault-site constants: NAME_SITE = "literal"
-SITE_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_SITE)\s*=\s*["']([A-Za-z0-9_]+)["']""")
-# obs/ledger.py work-counter constants: NAME_WORK = "literal"
-WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""")
-# ops/pallas_snn.py SNN-impl constants: NAME_SNN_IMPL = "literal"
-SNN_IMPL_RE = re.compile(
-    r"""^([A-Z][A-Z0-9_]*_SNN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
-)
-# obs/alerts.py alert-rule constants: NAME_ALERT = "literal"
-ALERT_RE = re.compile(
-    r"""^([A-Z][A-Z0-9_]*_ALERT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
-)
-# obs/flight.py dump-reason constants: NAME_FLIGHT = "literal"
-FLIGHT_RE = re.compile(
-    r"""^([A-Z][A-Z0-9_]*_FLIGHT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
-)
-# literal site names at fault-spec strings in tools/chaos_audit.py presets:
-# "site:kind[:arg]" — the first segment must be a registered fault site
-SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
-# literal checkpoint names at numeric_checkpoint(...) call sites (package
-# call sites import the *_CKPT constants, but a literal must still resolve)
-CKPT_CALL_RE = re.compile(
-    r"""numeric_checkpoint\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
-)
-
-# Scanned trees/files, relative to the repo root. Tests are exempt (they
-# exercise the machinery with throwaway names on purpose). The package walk
-# covers every subpackage — serve/ (the online-assignment subsystem, ISSUE 3)
-# included; tests/test_serve.py pins that coverage so a future repo
-# reorganisation cannot silently drop it. Standalone drivers that emit or
-# read instrumentation by literal name are listed explicitly: serve_demo.py
-# (ISSUE 3) and loadgen.py (ISSUE 7 — its /metrics parity check reads
-# histograms by name; a typo'd literal there would silently parity-check
-# an always-empty series).
-SCAN = (
-    "consensusclustr_tpu",
-    "bench.py",
-    os.path.join("tools", "serve_demo.py"),
-    os.path.join("tools", "loadgen.py"),
-    # ISSUE 8: the parity auditor consumes checkpoint streams by name — a
-    # typo'd literal there would audit an always-empty stage
-    os.path.join("tools", "parity_audit.py"),
-    # ISSUE 10: the chaos auditor plants faults by site name — a typo'd
-    # site there would "prove" resilience by never firing
-    os.path.join("tools", "chaos_audit.py"),
-)
-
-
-def _py_files(root: str) -> List[str]:
-    out = []
-    for target in SCAN:
-        path = os.path.join(root, target)
-        if os.path.isfile(path):
-            out.append(path)
-            continue
-        for dirpath, _, names in os.walk(path):
-            out.extend(
-                os.path.join(dirpath, n) for n in names if n.endswith(".py")
-            )
-    return sorted(out)
-
-
-def check_help_registry() -> List[str]:
-    """METRIC_HELP <-> METRIC_NAMES consistency (the Prometheus # HELP
-    contract): every registered metric documented, every help entry
-    registered."""
-    errors: List[str] = []
-    help_map = getattr(schema, "METRIC_HELP", None)
-    if help_map is None:
-        return ["obs/schema.py: METRIC_HELP registry is missing"]
-    for name in sorted(schema.METRIC_NAMES - set(help_map)):
-        errors.append(
-            f"obs/schema.py: metric {name!r} registered without METRIC_HELP "
-            "text (Prometheus # HELP would be empty)"
-        )
-    for name in sorted(set(help_map) - schema.METRIC_NAMES):
-        errors.append(
-            f"obs/schema.py: METRIC_HELP entry {name!r} not in METRIC_NAMES"
-        )
-    for name, text in sorted(help_map.items()):
-        if not str(text).strip():
-            errors.append(f"obs/schema.py: METRIC_HELP for {name!r} is empty")
-    return errors
-
-
-def _scan_constants(path: str, regex) -> dict:
-    """{literal: (CONST_NAME, lineno)} for module-level constants matching
-    ``regex`` in ``path``."""
-    found: dict = {}
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            m = regex.match(line)
-            if m:
-                found[m.group(2)] = (m.group(1), lineno)
-    return found
-
-
-def _check_constant_registry(
-    root: str,
-    rel: str,
-    regex,
-    registry_name: str,
-    kind: str,
-    require_complete: bool,
-) -> List[str]:
-    """Module-level constant literals in ``rel`` <-> the ``registry_name``
-    set in obs/schema.py. Every literal must be registered; with
-    ``require_complete`` every registry entry must also be backed by a
-    literal in ``rel`` (the defining module). Roots missing ``rel`` (the
-    synthetic trees the tests build) have nothing to validate and pass
-    clean."""
-    path = os.path.join(root, rel)
-    if not os.path.isfile(path):
-        return []
-    registry = getattr(schema, registry_name, None)
-    if registry is None:
-        return [f"obs/schema.py: {registry_name} registry is missing"]
-    errors: List[str] = []
-    found = _scan_constants(path, regex)
-    for name, (const, lineno) in sorted(found.items()):
-        if name not in registry:
-            errors.append(
-                f"{rel}:{lineno}: {kind} {name!r} ({const}) not in "
-                f"obs.schema.{registry_name}"
-            )
-    if require_complete:
-        for name in sorted(set(registry) - set(found)):
-            errors.append(
-                f"obs/schema.py: {registry_name} entry {name!r} has no "
-                f"literal constant in {rel}"
-            )
-    return errors
-
-
-def check_resource_attrs(root: str) -> List[str]:
-    """obs/resource.py ``*_ATTR`` literals <-> schema.RESOURCE_SPAN_ATTRS,
-    both directions: every literal registered, every registered attr backed
-    by a literal."""
-    return _check_constant_registry(
-        root, os.path.join("consensusclustr_tpu", "obs", "resource.py"),
-        ATTR_RE, "RESOURCE_SPAN_ATTRS", "span attr", require_complete=True,
-    )
-
-
-def check_numeric_registry(root: str) -> List[str]:
-    """ISSUE 8: the numerics registries, both directions.
-
-    * obs/fingerprint.py ``*_CKPT`` literals <-> schema.NUMERIC_CHECKPOINTS
-      (complete: every registered checkpoint must have a defining constant —
-      call sites import these, so an unbacked registry entry means a
-      checkpoint nothing can stamp);
-    * obs/fingerprint.py ``*_ATTR`` literals <-> schema.NUMERIC_SPAN_ATTRS
-      (complete, same contract as the resource attrs);
-    * tools/parity_audit.py ``*_CKPT`` literals must be registered (not
-      complete — the auditor consumes streams, it defines no checkpoints).
-    """
-    fp_rel = os.path.join("consensusclustr_tpu", "obs", "fingerprint.py")
-    audit_rel = os.path.join("tools", "parity_audit.py")
-    errors = _check_constant_registry(
-        root, fp_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
-        require_complete=True,
-    )
-    errors += _check_constant_registry(
-        root, fp_rel, ATTR_RE, "NUMERIC_SPAN_ATTRS", "span attr",
-        require_complete=True,
-    )
-    errors += _check_constant_registry(
-        root, audit_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
-        require_complete=False,
-    )
-    return errors
-
-
-def check_consensus_attrs(root: str) -> List[str]:
-    """ISSUE 9: consensus/pipeline.py ``*_ATTR`` literals (the regime
-    provenance stamped on the candidates/cocluster spans) <->
-    schema.CONSENSUS_SPAN_ATTRS, both directions — a renamed regime attr is
-    a test failure, not a silently empty "== consensus ==" table in
-    tools/report.py."""
-    return _check_constant_registry(
-        root,
-        os.path.join("consensusclustr_tpu", "consensus", "pipeline.py"),
-        ATTR_RE, "CONSENSUS_SPAN_ATTRS", "span attr", require_complete=True,
-    )
-
-
-def check_fault_sites(root: str) -> List[str]:
-    """ISSUE 10: the fault-site registry, both directions.
-
-    * resilience/inject.py ``*_SITE`` literals <-> schema.FAULT_SITES
-      (complete: every registered site must have a defining constant — call
-      sites import these, so an unbacked registry entry means a site nothing
-      can plant);
-    * tools/chaos_audit.py fault-spec literals ("site:kind") must name
-      registered sites (not complete — the auditor consumes sites).
-    """
-    errors = _check_constant_registry(
-        root,
-        os.path.join("consensusclustr_tpu", "resilience", "inject.py"),
-        SITE_RE, "FAULT_SITES", "fault site", require_complete=True,
-    )
-    audit = os.path.join(root, "tools", "chaos_audit.py")
-    registry = getattr(schema, "FAULT_SITES", frozenset())
-    if os.path.isfile(audit):
-        with open(audit, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                for m in SITE_SPEC_RE.finditer(line):
-                    if m.group(1) not in registry:
-                        errors.append(
-                            f"tools/chaos_audit.py:{lineno}: fault site "
-                            f"{m.group(1)!r} not in obs.schema.FAULT_SITES"
-                        )
-    return errors
-
-
-def _literal_assign(path: str, name: str):
-    """The literal value of a module-level ``name = <literal>`` assignment in
-    ``path`` (via ast — the file is never imported), or None when absent or
-    non-literal."""
-    import ast
-
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-            if name in targets:
-                try:
-                    return ast.literal_eval(node.value)
-                except ValueError:
-                    return None
-    return None
-
-
-def check_work_ledger(root: str) -> List[str]:
-    """ISSUE 12: the work-ledger registry, three ways.
-
-    * obs/ledger.py ``*_WORK`` literals <-> schema.WORK_LEDGER_COUNTERS
-      (complete: every registered counter must have a defining constant —
-      the ledger harvests by these names, so an unbacked registry entry is
-      a counter nothing sums);
-    * WORK_LEDGER_COUNTERS must be a subset of METRIC_NAMES — the ledger
-      only sums counters the metrics registry already owns, so a ledger
-      entry outside METRIC_NAMES would read a series nothing increments;
-    * bench.py's import-failure fallbacks (``_DISPATCH_FALLBACK`` /
-      ``_LEDGER_FALLBACK``) and tools/perf_history.py's
-      ``FLAT_LEDGER_KEYS`` are pinned (via ast, never imported) to
-      obs.ledger's ``BENCH_DISPATCH_KEYS`` / ``LEDGER_COUNTERS`` — the
-      failure-payload rung must stay key-identical to the real rungs even
-      when the package cannot import. Roots without bench.py (the
-      synthetic trees the tests build) skip the pinning.
-    """
-    errors = _check_constant_registry(
-        root, os.path.join("consensusclustr_tpu", "obs", "ledger.py"),
-        WORK_RE, "WORK_LEDGER_COUNTERS", "work counter", require_complete=True,
-    )
-    registry = getattr(schema, "WORK_LEDGER_COUNTERS", None)
-    if registry is not None:
-        for name in sorted(set(registry) - schema.METRIC_NAMES):
-            errors.append(
-                f"obs/schema.py: WORK_LEDGER_COUNTERS entry {name!r} not in "
-                "METRIC_NAMES (the ledger would sum a series nothing "
-                "increments)"
-            )
-    if not os.path.isfile(
-        os.path.join(root, "consensusclustr_tpu", "obs", "ledger.py")
-    ):
-        return errors
-    try:
-        from consensusclustr_tpu.obs import ledger
-    except Exception as e:  # pragma: no cover - import breakage is its own bug
-        return errors + [f"obs/ledger.py: import failed ({e})"]
-    pins = (
-        ("bench.py", "_DISPATCH_FALLBACK", dict(ledger.BENCH_DISPATCH_KEYS)),
-        ("bench.py", "_LEDGER_FALLBACK", tuple(ledger.LEDGER_COUNTERS)),
-        (os.path.join("tools", "perf_history.py"), "FLAT_LEDGER_KEYS",
-         dict(ledger.BENCH_DISPATCH_KEYS)),
-    )
-    for rel, const, want in pins:
-        path = os.path.join(root, rel)
-        if not os.path.isfile(path):
-            continue
-        got = _literal_assign(path, const)
-        if got != want:
-            errors.append(
-                f"{rel}: {const} drifted from obs.ledger "
-                f"(got {got!r}, expected {want!r})"
-            )
-    return errors
-
-
-def check_snn_impls(root: str) -> List[str]:
-    """ISSUE 13: the SNN-implementation registry, both directions.
-
-    * ops/pallas_snn.py ``*_SNN_IMPL`` literals <-> schema.SNN_IMPLS
-      (complete: every registered impl must have a defining constant — the
-      dispatch vocabulary lives where the kernel does, so an unbacked
-      registry entry is an impl nothing can select);
-    * cluster/engine.py's ``SNN_IMPLS`` dispatch tuple is ast-pinned to the
-      registry (set equality) — resolve_snn_impl must accept exactly the
-      registered vocabulary.
-    """
-    errors = _check_constant_registry(
-        root, os.path.join("consensusclustr_tpu", "ops", "pallas_snn.py"),
-        SNN_IMPL_RE, "SNN_IMPLS", "snn impl", require_complete=True,
-    )
-    engine = os.path.join(root, "consensusclustr_tpu", "cluster", "engine.py")
-    registry = getattr(schema, "SNN_IMPLS", None)
-    if registry is not None and os.path.isfile(engine):
-        got = _literal_assign(engine, "SNN_IMPLS")
-        if got is not None and set(got) != set(registry):
-            errors.append(
-                "consensusclustr_tpu/cluster/engine.py: SNN_IMPLS drifted "
-                f"from obs.schema.SNN_IMPLS (got {sorted(got)!r}, expected "
-                f"{sorted(registry)!r})"
-            )
-    return errors
-
-
-def check_flight_alerts(root: str) -> List[str]:
-    """ISSUE 14: the failure-layer registries, both directions.
-
-    * obs/alerts.py ``*_ALERT`` literals <-> schema.ALERT_RULES (complete:
-      every registered rule must have a defining constant — consumers
-      import these, so an unbacked registry entry is a rule nothing can
-      reference);
-    * obs/flight.py ``*_FLIGHT`` literals <-> schema.FLIGHT_EVENT_KINDS
-      (complete, same contract — dump reasons are the post-mortem
-      vocabulary);
-    * serve/service.py and the cross-module consumers (flight.py's
-      ``*_ALERT``, alerts.py's ``*_FLIGHT``) registered-only — they consume
-      the vocabulary, they define none of it.
-    """
-    alerts_rel = os.path.join("consensusclustr_tpu", "obs", "alerts.py")
-    flight_rel = os.path.join("consensusclustr_tpu", "obs", "flight.py")
-    service_rel = os.path.join("consensusclustr_tpu", "serve", "service.py")
-    errors = _check_constant_registry(
-        root, alerts_rel, ALERT_RE, "ALERT_RULES", "alert rule",
-        require_complete=True,
-    )
-    errors += _check_constant_registry(
-        root, flight_rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
-        require_complete=True,
-    )
-    for rel in (service_rel, flight_rel):
-        errors += _check_constant_registry(
-            root, rel, ALERT_RE, "ALERT_RULES", "alert rule",
-            require_complete=False,
-        )
-    for rel in (service_rel, alerts_rel):
-        errors += _check_constant_registry(
-            root, rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
-            require_complete=False,
-        )
-    return errors
-
-
-def check(root: str) -> List[str]:
-    """All schema violations under ``root`` as "file:line: message" strings."""
-    errors: List[str] = (
-        check_help_registry()
-        + check_resource_attrs(root)
-        + check_numeric_registry(root)
-        + check_consensus_attrs(root)
-        + check_fault_sites(root)
-        + check_work_ledger(root)
-        + check_snn_impls(root)
-        + check_flight_alerts(root)
-    )
-    for path in _py_files(root):
-        rel = os.path.relpath(path, root)
-        with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                for m in EVENT_RE.finditer(line):
-                    if m.group(1) not in schema.EVENT_KINDS:
-                        errors.append(
-                            f"{rel}:{lineno}: event kind {m.group(1)!r} not in "
-                            "obs.schema.EVENT_KINDS"
-                        )
-                for regex in (SPAN_RE, MAYBE_SPAN_RE):
-                    for m in regex.finditer(line):
-                        if m.group(1) not in schema.SPAN_NAMES:
-                            errors.append(
-                                f"{rel}:{lineno}: span name {m.group(1)!r} not "
-                                "in obs.schema.SPAN_NAMES"
-                            )
-                for m in METRIC_RE.finditer(line):
-                    if m.group(2) not in schema.METRIC_NAMES:
-                        errors.append(
-                            f"{rel}:{lineno}: metric name {m.group(2)!r} "
-                            f"({m.group(1)}) not in obs.schema.METRIC_NAMES"
-                        )
-                for m in CKPT_CALL_RE.finditer(line):
-                    if m.group(1) not in getattr(
-                        schema, "NUMERIC_CHECKPOINTS", frozenset()
-                    ):
-                        errors.append(
-                            f"{rel}:{lineno}: checkpoint {m.group(1)!r} not "
-                            "in obs.schema.NUMERIC_CHECKPOINTS"
-                        )
-    return errors
 
 
 def main() -> int:

@@ -1,0 +1,25 @@
+"""graftlint — pluggable JAX-aware static analysis for this repo.
+
+Turns the repo's past bug classes into permanent lint rules: the obs-schema
+registries (GL001), the CCTPU_* env-knob registry + generated docs (GL002),
+unpinned-dtype draws (GL003, the PR 8 x64 jitter bug), raw ``jax.jit``
+bypassing ``counting_jit`` (GL004, the work-ledger contract), resolved-but-
+unused ``resolve_*()`` results (GL005, the PR 10 CCTPU_GRID_IMPL bug),
+nondeterminism in library code (GL006) and silent broad excepts (GL007).
+
+Run ``python -m tools.graftlint`` from the repo root; see ``--explain``.
+"""
+
+from tools.graftlint.core import (  # noqa: F401  (public surface)
+    DEFAULT_BASELINE,
+    Finding,
+    REPO_ROOT,
+    Rule,
+    RunResult,
+    all_rules,
+    explain,
+    register,
+    render_text,
+    run,
+    write_baseline,
+)

@@ -1,0 +1,103 @@
+"""graftlint CLI.
+
+Usage:
+  python -m tools.graftlint [paths...]        lint the package (or files)
+  python -m tools.graftlint --json            machine-readable findings
+  python -m tools.graftlint --select GL003    run a subset of rules
+  python -m tools.graftlint --explain [CODE]  rule catalog / one rule's docs
+  python -m tools.graftlint --write-baseline  grandfather current findings
+  python -m tools.graftlint --gen-env-docs    regenerate the docs/quirks.md
+                                              env-knob table from ENV_KNOBS
+
+Exit codes match the bench_diff convention: 0 clean, 1 usage, 3 violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help=(
+        "explicit .py files to lint (fixture mode: file rules only, path "
+        "exemptions off); default = the package tree under --root"
+    ))
+    ap.add_argument("--root", default=core.REPO_ROOT)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--select", default=None, help="comma-separated GL0xx codes")
+    ap.add_argument("--explain", nargs="?", const="", default=None,
+                    metavar="CODE")
+    ap.add_argument("--baseline", default=core.DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--gen-env-docs", action="store_true", help=(
+        "regenerate the generated env-knob table in docs/quirks.md from "
+        "obs.schema.ENV_KNOBS, then exit"
+    ))
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 1 if e.code not in (0, None) else 0
+
+    if args.explain is not None:
+        try:
+            print(core.explain(args.explain or None))
+        except KeyError:
+            print(f"unknown rule code {args.explain!r}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.gen_env_docs:
+        from tools.graftlint.rules import env_knobs
+
+        try:
+            changed = env_knobs.write_env_docs(args.root)
+        except Exception as e:
+            print(f"--gen-env-docs failed: {e}", file=sys.stderr)
+            return 1
+        print("docs/quirks.md env-knob table "
+              + ("regenerated" if changed else "already current"))
+        return 0
+
+    select = (
+        [c.strip() for c in args.select.split(",") if c.strip()]
+        if args.select else None
+    )
+    baseline = None if args.no_baseline else args.baseline
+    res = core.run(
+        root=args.root,
+        paths=args.paths or None,
+        select=select,
+        baseline_path=baseline,
+    )
+    if args.write_baseline:
+        if res.errors:
+            print(core.render_text(res), file=sys.stderr)
+            return 1
+        core.write_baseline(args.baseline, res.violations + res.baselined)
+        print(f"baseline written: {args.baseline} "
+              f"({len(res.violations) + len(res.baselined)} entries)")
+        return 0
+    if args.as_json:
+        print(json.dumps(res.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(core.render_text(res))
+    return res.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""graftlint core: the pluggable JAX-aware static-analysis framework.
+
+This module owns everything rule-agnostic:
+
+* ``Rule`` — the plugin base class. A rule has a stable code (``GL0xx``), a
+  short name, a severity, and a docstring (rendered by ``--explain``). File
+  rules implement ``check_file`` and run once per parsed source file;
+  project rules implement ``check_project`` and run once per repo root
+  (cross-file registries, docs drift).
+* ``Finding`` — one violation: code, repo-relative path, 1-based line,
+  message.
+* Inline suppressions — ``# graftlint: noqa[GL003] <reason>`` silences
+  exactly the named codes on exactly that line. The reason is mandatory and
+  a bare ``noqa`` (no codes, or no reason) is itself a violation (code
+  GL000), so suppressions stay auditable.
+* The committed baseline (``tools/graftlint/baseline.json``) — grandfathered
+  findings matched by (code, path, message), line-number independent so the
+  baseline survives unrelated edits. A baseline entry that no longer matches
+  any live finding is *stale* and reported as a GL000 violation: fixed debt
+  must leave the ledger.
+* Exit codes, matching the bench_diff convention: 0 clean, 1 usage error,
+  3 violations.
+
+Rules register themselves via the ``@register`` decorator at import time;
+``tools/graftlint/rules/__init__`` imports every rule module, so adding a
+rule is: drop a module in rules/, subclass Rule, decorate. Everything here
+is stdlib-only — the linter must run (and fail loudly) even in an
+environment where jax cannot import.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+
+# The meta-rule code for suppression/baseline hygiene findings (bare noqa,
+# missing reason, unknown code, stale baseline entry). Not suppressible.
+HYGIENE_CODE = "GL000"
+
+CODE_RE = re.compile(r"^GL\d{3}$")
+NOQA_RE = re.compile(
+    r"#\s*graftlint:\s*noqa"          # the marker
+    r"(?:\[([A-Za-z0-9_,\s]*)\])?"     # optional [GL003] / [GL003,GL004]
+    r"\s*(.*)$"                        # the mandatory reason
+)
+
+
+class Finding:
+    """One violation. ``path`` is repo-relative; ``line`` is 1-based."""
+
+    __slots__ = ("code", "path", "line", "message", "severity")
+
+    def __init__(self, code: str, path: str, line: int, message: str,
+                 severity: str = "error") -> None:
+        self.code = code
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.severity = severity
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline-match key: line numbers excluded on purpose so an edit
+        above a grandfathered finding does not un-grandfather it."""
+        return (self.code, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "message": self.message, "severity": self.severity,
+        }
+
+
+class PyFile:
+    """One parsed source file handed to file rules (AST parsed once)."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+
+
+class Context:
+    """What a rule sees: the repo root plus the parsed file set."""
+
+    def __init__(self, root: str, files: Sequence[PyFile]) -> None:
+        self.root = root
+        self.files = list(files)
+
+
+class Rule:
+    """Base class for lint rules.
+
+    Subclasses set ``code`` (stable ``GL0xx`` identifier — noqa comments and
+    the baseline refer to it), ``name`` (short kebab-case slug), ``severity``
+    and ``scope`` ("file" or "project"), and write a docstring: the first
+    line is the summary shown by ``--explain`` with no argument, the full
+    docstring is the rule's documentation (``--explain GL0xx``) — which bug
+    class it descends from, what it flags, and when a noqa is acceptable.
+    """
+
+    code: str = ""
+    name: str = ""
+    severity: str = "error"
+    scope: str = "file"  # or "project"
+
+    def applies_to(self, rel: str) -> bool:
+        """Whether this file rule scans ``rel`` during a full-tree run.
+        Explicitly named files (fixtures) bypass this filter."""
+        return rel.replace(os.sep, "/").startswith("consensusclustr_tpu/")
+
+    def check_file(self, ctx: Context, pf: PyFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctx: Context) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule by its code."""
+    rule = cls()
+    if not CODE_RE.match(rule.code or ""):
+        raise ValueError(f"rule {cls.__name__} has invalid code {rule.code!r}")
+    if rule.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {rule.code}")
+    _REGISTRY[rule.code] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """Code -> rule, with the rule modules imported (idempotent)."""
+    from tools.graftlint import rules  # noqa: F401  (import registers rules)
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# ---------------------------------------------------------------------------
+# noqa suppressions
+
+
+class Noqa:
+    __slots__ = ("line", "codes", "reason", "raw")
+
+    def __init__(self, line: int, codes: List[str], reason: str, raw: str):
+        self.line = line
+        self.codes = codes
+        self.reason = reason
+        self.raw = raw
+
+
+def scan_noqa(pf: PyFile) -> Tuple[List[Noqa], List[Finding]]:
+    """All ``# graftlint: noqa[...]`` comments in ``pf`` plus the hygiene
+    findings they earn (bare noqa, missing reason, unknown code). Comments
+    are found with tokenize so a marker inside a string literal is never
+    misread as a suppression."""
+    noqas: List[Noqa] = []
+    findings: List[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(pf.source).readline)
+        comments = [
+            (t.start[0], t.string) for t in tokens
+            if t.type == tokenize.COMMENT and "graftlint" in t.string
+        ]
+    except tokenize.TokenError:
+        comments = [
+            (i, line[line.index("#"):])
+            for i, line in enumerate(pf.source.splitlines(), 1)
+            if "#" in line and "graftlint" in line
+        ]
+    known = set(all_rules())
+    for lineno, text in comments:
+        m = NOQA_RE.search(text)
+        if not m:
+            continue
+        codes_raw, reason = m.group(1), (m.group(2) or "").strip()
+        if codes_raw is None or not codes_raw.strip():
+            findings.append(Finding(
+                HYGIENE_CODE, pf.rel, lineno,
+                "bare `# graftlint: noqa` — name the code(s) being "
+                "suppressed, e.g. `noqa[GL003] <reason>`",
+            ))
+            continue
+        codes = [c.strip() for c in codes_raw.split(",") if c.strip()]
+        bad = [c for c in codes if c not in known or c == HYGIENE_CODE]
+        if bad:
+            findings.append(Finding(
+                HYGIENE_CODE, pf.rel, lineno,
+                f"noqa names unknown/unsuppressible rule code(s) "
+                f"{', '.join(bad)}",
+            ))
+            codes = [c for c in codes if c not in bad]
+        if not reason:
+            findings.append(Finding(
+                HYGIENE_CODE, pf.rel, lineno,
+                f"noqa[{','.join(codes) or '?'}] without a reason — the "
+                "reason is mandatory (why is this site exempt?)",
+            ))
+            continue  # a reasonless noqa suppresses nothing
+        if codes:
+            noqas.append(Noqa(lineno, codes, reason, text))
+    return noqas, findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: Optional[str]) -> Tuple[List[dict], List[str]]:
+    """(entries, errors). A missing file is an empty baseline; a malformed
+    one is a usage error."""
+    if not path or not os.path.isfile(path):
+        return [], []
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = list(data.get("entries", []))
+        for e in entries:
+            if not all(k in e for k in ("code", "path", "message")):
+                return [], [f"{path}: baseline entry missing keys: {e!r}"]
+        return entries, []
+    except (OSError, ValueError) as e:
+        return [], [f"{path}: unreadable baseline ({e})"]
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        ({"code": f.code, "path": f.path, "message": f.message}
+         for f in findings if f.code != HYGIENE_CODE),
+        key=lambda e: (e["path"], e["code"], e["message"]),
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+def discover_files(root: str) -> List[str]:
+    """The package tree file rules scan on a full run."""
+    out: List[str] = []
+    pkg = os.path.join(root, "consensusclustr_tpu")
+    for dirpath, _, names in os.walk(pkg):
+        out.extend(
+            os.path.join(dirpath, n) for n in names if n.endswith(".py")
+        )
+    return sorted(out)
+
+
+class RunResult:
+    def __init__(self) -> None:
+        self.violations: List[Finding] = []
+        self.baselined: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.rules_run: List[str] = []
+        self.files_scanned: int = 0
+        self.baseline_size: int = 0
+        self.errors: List[str] = []  # usage-level problems
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 1
+        return 3 if self.violations else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "tool": "graftlint",
+            "rules_run": self.rules_run,
+            "files_scanned": self.files_scanned,
+            "baseline_size": self.baseline_size,
+            "violations": [f.to_dict() for f in self.violations],
+            "baselined": len(self.baselined),
+            "noqa_suppressed": len(self.suppressed),
+            "errors": self.errors,
+        }
+
+
+def run(
+    root: str = REPO_ROOT,
+    paths: Optional[Sequence[str]] = None,
+    select: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+) -> RunResult:
+    """Run the framework.
+
+    ``paths`` — explicit .py files (fixture mode): file rules run on exactly
+    those files with path exemptions off and project rules skipped.
+    Otherwise the package tree under ``root`` is scanned and project rules
+    run once. ``select`` restricts to the given codes. The baseline applies
+    in both modes (fixture files simply never match committed entries).
+    """
+    res = RunResult()
+    rules = all_rules()
+    if select:
+        unknown = [c for c in select if c not in rules]
+        if unknown:
+            res.errors.append(f"unknown rule code(s): {', '.join(unknown)}")
+            return res
+        rules = {c: r for c, r in rules.items() if c in select}
+    res.rules_run = sorted(rules)
+
+    explicit = paths is not None
+    file_list = list(paths) if explicit else discover_files(root)
+    pfs: List[PyFile] = []
+    for p in file_list:
+        ap = os.path.abspath(p)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+            pfs.append(PyFile(ap, rel, src))
+        except OSError as e:
+            res.errors.append(f"{p}: unreadable ({e})")
+        except SyntaxError as e:
+            res.errors.append(f"{p}: syntax error ({e})")
+    if res.errors:
+        return res
+    res.files_scanned = len(pfs)
+    ctx = Context(root, pfs)
+
+    findings: List[Finding] = []
+    noqa_by_file: Dict[str, List[Noqa]] = {}
+    for pf in pfs:
+        noqas, hygiene = scan_noqa(pf)
+        noqa_by_file[pf.rel] = noqas
+        findings.extend(hygiene)
+    for code, rule in rules.items():
+        if rule.scope == "file":
+            for pf in pfs:
+                if explicit or rule.applies_to(pf.rel):
+                    findings.extend(rule.check_file(ctx, pf))
+        elif not explicit:
+            findings.extend(rule.check_project(ctx))
+
+    # inline suppressions: exactly the named codes on exactly that line
+    kept: List[Finding] = []
+    for f in findings:
+        matched = None
+        if f.code != HYGIENE_CODE:
+            for nq in noqa_by_file.get(f.path, ()):
+                if nq.line == f.line and f.code in nq.codes:
+                    matched = nq
+                    break
+        (res.suppressed if matched else kept).append(f)
+
+    # baseline: grandfathered findings are reported separately; stale
+    # entries (fixed findings still listed) are violations
+    entries, berrs = load_baseline(baseline_path)
+    if berrs:
+        res.errors.extend(berrs)
+        return res
+    res.baseline_size = len(entries)
+    keys = {(e["code"], e["path"], e["message"]) for e in entries}
+    matched_keys = set()
+    final: List[Finding] = []
+    for f in kept:
+        if f.key() in keys:
+            matched_keys.add(f.key())
+            res.baselined.append(f)
+        else:
+            final.append(f)
+    rel_base = os.path.relpath(
+        baseline_path, root).replace(os.sep, "/") if baseline_path else ""
+    for e in sorted(entries, key=lambda e: (e["path"], e["code"])):
+        k = (e["code"], e["path"], e["message"])
+        if k not in matched_keys and (not select or e["code"] in select):
+            final.append(Finding(
+                HYGIENE_CODE, rel_base, 1,
+                f"stale baseline entry ({e['code']} {e['path']}: "
+                f"{e['message']}) — the finding is fixed; delete it from "
+                "the baseline",
+            ))
+    final.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    res.violations = final
+    return res
+
+
+def render_text(res: RunResult) -> str:
+    lines = [f.render() for f in res.violations]
+    if res.errors:
+        lines.extend(f"usage: {e}" for e in res.errors)
+    summary = (
+        f"graftlint: {len(res.violations)} violation(s)"
+        f" [{len(res.baselined)} baselined, {len(res.suppressed)} noqa]"
+        f" — {len(res.rules_run)} rules over {res.files_scanned} files"
+    )
+    if not res.violations and not res.errors:
+        summary = (
+            f"graftlint: clean — {len(res.rules_run)} rules over "
+            f"{res.files_scanned} files"
+            f" [{len(res.baselined)} baselined, {len(res.suppressed)} noqa]"
+        )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def explain(code: Optional[str] = None) -> str:
+    """--explain: the rule catalog (no code) or one rule's full docstring."""
+    rules = all_rules()
+    if code is None:
+        out = ["graftlint rules:"]
+        for c, r in rules.items():
+            doc = (r.__class__.__doc__ or "").strip().splitlines()
+            head = doc[0] if doc else ""
+            out.append(f"  {c} [{r.severity:5s}] {r.name}: {head}")
+        out.append(
+            f"  {HYGIENE_CODE} [error] suppression-hygiene: bare/reasonless "
+            "noqa and stale baseline entries (built into the framework)"
+        )
+        return "\n".join(out)
+    if code not in rules:
+        raise KeyError(code)
+    r = rules[code]
+    doc = (r.__class__.__doc__ or "(no documentation)").strip()
+    return f"{code} [{r.severity}] {r.name}\n\n{doc}"

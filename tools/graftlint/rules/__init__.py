@@ -1,0 +1,16 @@
+"""graftlint rule modules — importing this package registers every rule.
+
+Adding a rule: drop a module here, subclass ``tools.graftlint.core.Rule``,
+decorate with ``@register``, and import it below. The docstring you write
+IS the rule's documentation (``graftlint --explain GL0xx``).
+"""
+
+from tools.graftlint.rules import (  # noqa: F401  (imports register rules)
+    dtype_pins,
+    env_knobs,
+    jit_ledger,
+    nondeterminism,
+    resolve_unused,
+    schema_registry,
+    silent_except,
+)

@@ -1,0 +1,124 @@
+"""GL003 — unpinned-dtype draws and array creators (the PR 8 bug class).
+
+Bug class: x64 dtype widening. PR 8's worst bug: ``jax.random.uniform``
+jitter added to SNN edge weights without ``dtype=`` defaulted to float64
+under ``jax_enable_x64``, changing Leiden tie-breaks — same seed, different
+clustering, discovered only by the parity audit. The same widening applies
+to the whole creator family: ``jnp.zeros``/``ones``/``empty``/``full``/
+``linspace``/``eye`` default f32 -> f64 and ``jnp.arange`` i32 -> i64 when
+x64 flips on.
+
+Flagged: calls to the draw family (``uniform``/``normal``/
+``truncated_normal``/``randint``) and the creator family (``zeros``/
+``ones``/``empty``/``full``/``arange``/``linspace``/``eye``/``identity``)
+on a jax-ish module (``jnp``, ``jax.numpy``, ``jax.random``, ``jrandom``,
+``jr``) without an explicit ``dtype=`` keyword. ``*_like`` creators inherit
+their dtype and are exempt; plain ``np.*`` is exempt (numpy never widens
+with the jax flag). ``jax.random.bernoulli`` has no dtype parameter — pin
+the ``p`` operand instead; the rule flags a bernoulli call only when ``p``
+is a bare Python float literal (weak-typed, widens).
+
+When is a noqa acceptable: a site that deliberately wants the ambient
+dtype (an x64 test helper, a dtype-polymorphic utility taking its dtype
+from an argument and merely defaulting). In library code the pin is almost
+always the fix — write ``dtype=jnp.float32`` (or the contextually correct
+dtype; mind weak-typing: pinning an int constant that feeds an int16 lane
+to int32 *changes* the result dtype).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import Finding, Rule, register
+
+JAXISH_BASES = {"jnp", "jax.numpy", "jax.random", "jrandom", "jr"}
+# function -> 0-based positional index of its dtype parameter; a call is
+# pinned when it passes dtype= by keyword OR fills that positional slot
+# (jnp.zeros((n,), jnp.float32) is pinned)
+DTYPE_SLOT = {
+    "zeros": 1, "ones": 1, "empty": 1, "identity": 1,
+    "full": 2,
+    "arange": 3, "eye": 3, "linspace": 5,
+    "uniform": 2, "normal": 2,
+    "truncated_normal": 4, "randint": 4,
+}
+
+
+def dotted(node: ast.AST):
+    """'jax.numpy' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+@register
+class DtypePinRule(Rule):
+    """Draws and array creators on jax modules must pin ``dtype=``.
+
+    Descends from the PR 8 x64 jitter bug: an unpinned
+    ``jax.random.uniform`` widened to float64 under ``jax_enable_x64`` and
+    changed Leiden tie-breaks. Flags ``uniform``/``normal``/
+    ``truncated_normal``/``randint`` and ``zeros``/``ones``/``empty``/
+    ``full``/``arange``/``linspace``/``eye``/``identity`` on ``jnp``/
+    ``jax.numpy``/``jax.random`` without ``dtype=`` (plus ``bernoulli``
+    with a bare float-literal ``p``). ``*_like`` and numpy calls are
+    exempt. noqa only for deliberately dtype-polymorphic sites; the usual
+    fix is pinning the contextually correct dtype (beware weak-typed int
+    constants feeding int16 lanes).
+    """
+
+    code = "GL003"
+    name = "unpinned-dtype"
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        out = []
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            base = dotted(node.func.value)
+            if base not in JAXISH_BASES:
+                continue
+            fn = node.func.attr
+            if fn in DTYPE_SLOT:
+                pinned = (
+                    _has_kw(node, "dtype")
+                    or len(node.args) > DTYPE_SLOT[fn]
+                )
+                if not pinned:
+                    out.append(Finding(
+                        "GL003", pf.rel, node.lineno,
+                        f"{base}.{fn}(...) without dtype= — widens under "
+                        "jax_enable_x64 (the PR 8 jitter bug class); pin "
+                        "the dtype explicitly",
+                    ))
+            elif fn == "bernoulli":
+                p = None
+                if len(node.args) >= 2:
+                    p = node.args[1]
+                else:
+                    for k in node.keywords:
+                        if k.arg == "p":
+                            p = k.value
+                if isinstance(p, ast.Constant) and isinstance(
+                    p.value, float
+                ):
+                    out.append(Finding(
+                        "GL003", pf.rel, node.lineno,
+                        f"{base}.bernoulli with a bare float-literal p — "
+                        "weak-typed, widens under jax_enable_x64; wrap p "
+                        "in jnp.float32(...)",
+                    ))
+        return out

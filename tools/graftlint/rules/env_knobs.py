@@ -1,0 +1,245 @@
+"""GL002 — the CCTPU_* env-knob registry and its generated docs.
+
+Bug class: knob drift. Before ISSUE 15 the package read 45+ distinct
+``CCTPU_*`` environment variables but docs/quirks.md documented 19 — an
+operator tuning a fleet had no single authoritative knob list, and a
+renamed knob kept its stale docs forever. The fix is a registry:
+``obs/schema.py::ENV_KNOBS`` maps every knob to (default, one-line help),
+the docs/quirks.md table is GENERATED from it between marker comments
+(``python -m tools.graftlint --gen-env-docs``), and this rule fails when
+any of the three drift:
+
+* a ``CCTPU_*`` name referenced in consensusclustr_tpu/, bench.py or
+  tools/ that is not in ENV_KNOBS (the knob exists, the registry lies);
+* an ENV_KNOBS entry no code references (the registry documents a ghost);
+* an ENV_KNOBS entry with empty help text;
+* a docs/quirks.md generated table that does not match what ENV_KNOBS
+  renders (regenerate with ``--gen-env-docs``).
+
+References are found as string constants in the AST (docstrings excluded,
+so prose *about* a knob is not a read). obs/schema.py itself (the registry)
+and tools/graftlint/ (this linter) are exempt from the reference scan.
+noqa is never acceptable for GL002 — register the knob or delete the read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Tuple
+
+from consensusclustr_tpu.obs import schema
+
+from tools.graftlint.core import Finding, Rule, register
+
+# A full knob name: must not end with "_" so prefix strings used for
+# namespace checks ("CCTPU_SERVE_") and doc prose are not counted as reads.
+KNOB_RE = re.compile(r"\bCCTPU_[A-Z0-9_]*[A-Z0-9]\b")
+
+SCHEMA_REL = "consensusclustr_tpu/obs/schema.py"
+DOCS_REL = os.path.join("docs", "quirks.md")
+BEGIN_MARK = "<!-- BEGIN ENV_KNOBS (generated: python -m tools.graftlint --gen-env-docs) -->"
+END_MARK = "<!-- END ENV_KNOBS -->"
+
+# Scanned for knob references, mirroring the check_obs_schema SCAN
+# philosophy: the package, the bench driver, and the tools layer.
+SCAN_DIRS = ("consensusclustr_tpu", "tools")
+SCAN_FILES = ("bench.py",)
+# The registry defines the vocabulary and the linter documents it — neither
+# is a "read" of a knob.
+EXEMPT_PREFIXES = (SCHEMA_REL, "tools/graftlint/")
+
+
+def _docstring_spans(tree: ast.AST):
+    """Line spans of every docstring constant, to exclude prose mentions."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                   ast.AsyncFunctionDef)
+        ):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                c = body[0].value
+                spans.append((c.lineno, c.end_lineno or c.lineno))
+    return spans
+
+
+def scan_knob_reads(root: str) -> Dict[str, List[Tuple[str, int]]]:
+    """knob name -> [(rel, line), ...] for every non-docstring string
+    constant mentioning a full CCTPU_* name under the scanned trees."""
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    files: List[str] = []
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _, names in os.walk(base):
+            files.extend(
+                os.path.join(dirpath, n) for n in names if n.endswith(".py")
+            )
+    for f in SCAN_FILES:
+        p = os.path.join(root, f)
+        if os.path.isfile(p):
+            files.append(p)
+    for path in sorted(files):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        if any(rel.startswith(pfx) for pfx in EXEMPT_PREFIXES):
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        doc_spans = _docstring_spans(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            line = node.lineno
+            if any(a <= line <= b for a, b in doc_spans):
+                continue
+            for m in KNOB_RE.finditer(node.value):
+                reads.setdefault(m.group(0), []).append((rel, line))
+    return reads
+
+
+def render_env_table() -> str:
+    """The generated docs/quirks.md section, markers included."""
+    knobs = getattr(schema, "ENV_KNOBS", {})
+    lines = [
+        BEGIN_MARK,
+        "",
+        "## Environment knobs (generated from `obs.schema.ENV_KNOBS` — ISSUE 15)",
+        "",
+        "Single authoritative list of every `CCTPU_*` variable the package,",
+        "`bench.py` and `tools/` read. Edit `ENV_KNOBS` in",
+        "`consensusclustr_tpu/obs/schema.py`, then regenerate this table with",
+        "`python -m tools.graftlint --gen-env-docs`; graftlint's GL002 rule",
+        "fails when code, registry and this table drift apart.",
+        "",
+        "| knob | default | effect |",
+        "|---|---|---|",
+    ]
+    for name in sorted(knobs):
+        default, help_text = knobs[name]
+        lines.append(f"| `{name}` | {default} | {help_text} |")
+    lines.append("")
+    lines.append(END_MARK)
+    return "\n".join(lines)
+
+
+def _read_docs(root: str):
+    path = os.path.join(root, DOCS_REL)
+    if not os.path.isfile(path):
+        return path, None
+    with open(path, encoding="utf-8") as fh:
+        return path, fh.read()
+
+
+def _current_section(text: str):
+    """(start, end, section) of the generated block in ``text``, or None."""
+    a = text.find(BEGIN_MARK)
+    if a < 0:
+        return None
+    b = text.find(END_MARK, a)
+    if b < 0:
+        return None
+    b += len(END_MARK)
+    return a, b, text[a:b]
+
+
+def write_env_docs(root: str) -> bool:
+    """Regenerate the docs/quirks.md knob table in place. Returns True when
+    the file changed. Appends the section when the markers are absent."""
+    path, text = _read_docs(root)
+    if text is None:
+        raise FileNotFoundError(path)
+    table = render_env_table()
+    loc = _current_section(text)
+    if loc is None:
+        new = text.rstrip("\n") + "\n\n" + table + "\n"
+    else:
+        a, b, _ = loc
+        new = text[:a] + table + text[b:]
+    if new == text:
+        return False
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    return True
+
+
+@register
+class EnvKnobRule(Rule):
+    """Every CCTPU_* knob is registered in ENV_KNOBS and documented, both ways.
+
+    See this module's docstring for the full contract: reads <-> registry
+    <-> generated docs/quirks.md table must all agree. Descends from the
+    47-read-vs-19-documented drift ISSUE 15 found. noqa is never
+    acceptable — register the knob (with real help text) or delete the
+    read, and regenerate the docs with ``--gen-env-docs``.
+    """
+
+    code = "GL002"
+    name = "env-knob-registry"
+    scope = "project"
+
+    def check_project(self, ctx):
+        findings: List[Finding] = []
+        knobs = getattr(schema, "ENV_KNOBS", None)
+        if knobs is None:
+            return [Finding(
+                "GL002", SCHEMA_REL, 1, "ENV_KNOBS registry is missing",
+            )]
+        reads = scan_knob_reads(ctx.root)
+        for name in sorted(set(reads) - set(knobs)):
+            rel, line = sorted(reads[name])[0]
+            findings.append(Finding(
+                "GL002", rel, line,
+                f"env knob {name!r} read in code but not in "
+                "obs.schema.ENV_KNOBS (register it: name, default, help)",
+            ))
+        for name in sorted(set(knobs) - set(reads)):
+            findings.append(Finding(
+                "GL002", SCHEMA_REL, 1,
+                f"ENV_KNOBS entry {name!r} is read nowhere in "
+                "consensusclustr_tpu/, bench.py or tools/ — delete it or "
+                "wire it up",
+            ))
+        for name in sorted(knobs):
+            entry = knobs[name]
+            if (not isinstance(entry, tuple) or len(entry) != 2
+                    or not str(entry[1]).strip()):
+                findings.append(Finding(
+                    "GL002", SCHEMA_REL, 1,
+                    f"ENV_KNOBS entry {name!r} needs a (default, help) "
+                    "tuple with non-empty help text",
+                ))
+        # docs drift: the generated table must match what ENV_KNOBS renders
+        _, text = _read_docs(ctx.root)
+        docs_rel = DOCS_REL.replace(os.sep, "/")
+        if text is None:
+            findings.append(Finding(
+                "GL002", docs_rel, 1,
+                "docs/quirks.md is missing — the generated env-knob table "
+                "lives there",
+            ))
+        else:
+            loc = _current_section(text)
+            if loc is None:
+                findings.append(Finding(
+                    "GL002", docs_rel, 1,
+                    "docs/quirks.md has no generated env-knob table — run "
+                    "`python -m tools.graftlint --gen-env-docs`",
+                ))
+            elif loc[2] != render_env_table():
+                line = text[:loc[0]].count("\n") + 1
+                findings.append(Finding(
+                    "GL002", docs_rel, line,
+                    "docs/quirks.md env-knob table drifted from "
+                    "obs.schema.ENV_KNOBS — run `python -m tools.graftlint "
+                    "--gen-env-docs`",
+                ))
+        return findings

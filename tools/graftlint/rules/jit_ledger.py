@@ -1,0 +1,77 @@
+"""GL004 — raw ``jax.jit`` bypassing ``counting_jit`` (the work ledger).
+
+Bug class: invisible work. PR 12's deterministic work ledger counts every
+top-level device program through ``utils/compile_cache.py::counting_jit``
+(per-program compile/dispatch counters, harvested into the bench payload
+and diffed by the noise-free ledger gates). A raw ``jax.jit`` introduced
+for a new entry program dispatches outside the ledger: the bench numbers
+stay green while real device work goes unaccounted — the regression the
+gates exist to catch becomes invisible to them.
+
+Flagged: any ``jax.jit`` reference (attribute use — decorator,
+``functools.partial(jax.jit, ...)``, direct call — or ``from jax import
+jit``) in package files other than ``utils/compile_cache.py`` (the wrapper
+itself).
+
+When is a noqa acceptable: *inner* kernels. A jitted helper that is only
+ever called from inside another traced program is inlined at trace time —
+its own dispatch counter would double-count under the outer program — and
+obs/fingerprint.py documents the same pattern for hashing outside the
+ledger on purpose. Top-level entry programs (anything a user-facing path
+dispatches directly) must use ``counting_jit``; converting an existing
+noqa'd inner site to ``counting_jit`` is a ledger-baseline change and
+needs the committed ledger expectations updated in the same PR.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import Finding, Rule, register
+from tools.graftlint.rules.dtype_pins import dotted
+
+
+@register
+class RawJitRule(Rule):
+    """``jax.jit`` outside utils/compile_cache.py bypasses the work ledger.
+
+    Descends from the PR 12 work-ledger contract: top-level device programs
+    go through ``counting_jit`` so the noise-free bench gates see their
+    compiles and dispatches. Flags every ``jax.jit`` attribute reference
+    and ``from jax import jit`` in package files other than
+    utils/compile_cache.py. noqa is acceptable for inner kernels (traced
+    inline from an outer program — their own counter would double-count);
+    entry programs must convert, updating the committed ledger baseline.
+    """
+
+    code = "GL004"
+    name = "raw-jax-jit"
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return (
+            rel.startswith("consensusclustr_tpu/")
+            and rel != "consensusclustr_tpu/utils/compile_cache.py"
+        )
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        out = []
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "jit":
+                if dotted(node.value) == "jax":
+                    out.append(Finding(
+                        "GL004", pf.rel, node.lineno,
+                        "raw jax.jit bypasses counting_jit — dispatches "
+                        "here are invisible to the PR 12 work ledger; use "
+                        "utils.compile_cache.counting_jit (or noqa an "
+                        "inner kernel with the reason)",
+                    ))
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                if any(a.name == "jit" for a in node.names):
+                    out.append(Finding(
+                        "GL004", pf.rel, node.lineno,
+                        "`from jax import jit` bypasses counting_jit — "
+                        "import utils.compile_cache.counting_jit instead",
+                    ))
+        return out

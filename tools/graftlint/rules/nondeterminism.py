@@ -1,0 +1,110 @@
+"""GL006 — module-state nondeterminism in library code.
+
+Bug class: irreproducible results. The package's whole premise is
+reproducibility — explicit JAX keys (``utils/rng.py``), a deterministic
+work ledger, noise-free bench gates. A ``time.time()`` / ``random.*`` /
+``np.random.*`` call in a numeric code path reintroduces run-to-run
+variance no seed controls, and it tends to arrive innocently (a jitter
+term, a tie-break, a "temporary" timestamp in a cache key).
+
+Flagged, in package files outside ``obs/`` (the observability layer *is*
+the timing layer — spans, samplers and watchdogs are exempt by
+construction; the tools/ tree is never scanned by file rules):
+
+* ``time.time`` / ``time.time_ns`` — wall-clock reads;
+* module-state stdlib ``random.*`` draws (``random.random`` et al). A
+  seeded instance (``random.Random(seed).random()``) is deterministic and
+  exempt — the rule only flags the module-level functions;
+* ``np.random.*`` / ``numpy.random.*`` module-state draws (the legacy
+  global generator).
+
+``time.monotonic``/``perf_counter`` are exempt: durations for logs and
+deadlines, not values that can leak into results.
+
+When is a noqa acceptable: provenance metadata deliberately stamped with
+wall-clock time (a manifest's ``created_unix``), never a numeric path —
+use ``utils/rng.py`` keys there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import Finding, Rule, register
+from tools.graftlint.rules.dtype_pins import dotted
+
+_TIME_FNS = {"time.time", "time.time_ns"}
+_RANDOM_MODULE_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate", "seed",
+    "getrandbits",
+}
+
+
+@register
+class NondeterminismRule(Rule):
+    """Wall-clock and module-state RNG calls in library code.
+
+    Descends from the package's reproducibility contract (explicit JAX
+    keys, deterministic work ledger): ``time.time``, module-level
+    ``random.*`` and ``np.random.*`` reintroduce variance no seed
+    controls. Seeded ``random.Random(seed)`` instances and monotonic
+    clocks are exempt; the obs/ layer is exempt wholesale (it is the
+    timing layer). noqa only for deliberate provenance timestamps.
+    """
+
+    code = "GL006"
+    name = "nondeterminism"
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return (
+            rel.startswith("consensusclustr_tpu/")
+            and not rel.startswith("consensusclustr_tpu/obs/")
+        )
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        out = []
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            full = dotted(node.func)
+            if full in _TIME_FNS:
+                out.append(Finding(
+                    "GL006", pf.rel, node.lineno,
+                    f"{full}() in library code — wall-clock reads are "
+                    "nondeterministic; use obs spans for timing or noqa a "
+                    "deliberate provenance timestamp",
+                ))
+                continue
+            base = dotted(node.func.value)
+            if base == "random" and node.func.attr in _RANDOM_MODULE_FNS:
+                out.append(Finding(
+                    "GL006", pf.rel, node.lineno,
+                    f"module-state random.{node.func.attr}() — seed-free "
+                    "nondeterminism; use utils/rng.py keys or a seeded "
+                    "random.Random(seed) instance",
+                ))
+            elif base in ("np.random", "numpy.random"):
+                if node.func.attr == "default_rng":
+                    # default_rng(seed) is the deterministic fix; only the
+                    # argless form (OS-entropy seeded) is a violation
+                    if node.args or node.keywords:
+                        continue
+                    out.append(Finding(
+                        "GL006", pf.rel, node.lineno,
+                        f"{base}.default_rng() without a seed draws OS "
+                        "entropy — pass the caller's seed explicitly",
+                    ))
+                    continue
+                if node.func.attr in ("SeedSequence", "Generator"):
+                    continue
+                out.append(Finding(
+                    "GL006", pf.rel, node.lineno,
+                    f"{base}.{node.func.attr}() uses numpy's global "
+                    "generator — seed-free nondeterminism; use "
+                    "utils/rng.py keys or np.random.default_rng(seed)",
+                ))
+        return out

@@ -1,0 +1,101 @@
+"""GL005 — a ``resolve_*()`` result bound but never read (the PR 10 bug).
+
+Bug class: resolved-but-unthreaded configuration. The repo's convention is
+``resolve_<knob>()`` functions that layer explicit argument > env var >
+default and validate. PR 10 found the worst instance: ``_boot_batch``
+called ``resolve_grid_impl(...)``, bound the result, and then dispatched
+the fused program unconditionally — ``CCTPU_GRID_IMPL=looped`` was
+accepted, validated, logged... and ignored, so tools/parity_audit.py
+silently compared fused against fused and the looped parity oracle never
+ran. Statically this is always the same shape: a ``resolve_*()`` result
+assigned to a name with no subsequent load of that name in the scope.
+
+Flagged: ``name = resolve_something(...)`` (single Name target, function
+name starting with ``resolve_``) where ``name`` is never loaded anywhere
+in the enclosing scope (nested-function closure reads count as loads).
+Binding to ``_`` is flagged too — a validation-only call should be a bare
+expression statement, which is exempt.
+
+When is a noqa acceptable: effectively never in library code. If the call
+is for its validation side effect, drop the binding; otherwise thread the
+value to where it dispatches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import Finding, Rule, register
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _resolve_call_name(value: ast.AST):
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return name if name and name.startswith("resolve_") else None
+
+
+def _walk_same_scope(node):
+    """All descendants of ``node`` without crossing into nested scopes."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _SCOPE_NODES):
+            continue
+        yield child
+        yield from _walk_same_scope(child)
+
+
+@register
+class ResolveUnusedRule(Rule):
+    """A ``resolve_*()`` result bound to a name that is never read.
+
+    Descends from the PR 10 ``CCTPU_GRID_IMPL`` bug: the knob was resolved
+    and validated, then the fused program dispatched unconditionally — the
+    parity audit silently compared fused against fused. Flags
+    ``name = resolve_*(...)`` with no subsequent load of ``name`` in the
+    enclosing scope. A validation-only call should be a bare expression
+    statement (exempt); otherwise thread the value. noqa is effectively
+    never acceptable here.
+    """
+
+    code = "GL005"
+    name = "resolve-unused"
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        out = []
+        scopes = [pf.tree] + [
+            n for n in ast.walk(pf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            assigns = [
+                (n, n.targets[0].id, _resolve_call_name(n.value))
+                for n in _walk_same_scope(scope)
+                if isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and _resolve_call_name(n.value)
+            ]
+            if not assigns:
+                continue
+            # loads over the WHOLE scope including nested functions —
+            # a closure read is a legitimate use of the resolved value
+            loaded = {
+                n.id for n in ast.walk(scope)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            }
+            for stmt, name, fn in assigns:
+                if name not in loaded:
+                    out.append(Finding(
+                        "GL005", pf.rel, stmt.lineno,
+                        f"{fn}() result bound to {name!r} but never read "
+                        "in this scope — the resolved value is not "
+                        "threaded anywhere (the PR 10 CCTPU_GRID_IMPL bug "
+                        "class)",
+                    ))
+        return out

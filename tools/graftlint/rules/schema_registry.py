@@ -1,0 +1,510 @@
+"""GL001 — observability-registry drift (the check_obs_schema rule family).
+
+This module absorbed tools/check_obs_schema.py wholesale (ISSUE 15): every
+one of its registry checks is an individual named sub-rule here, and
+``tools/check_obs_schema.py`` survives as a thin wrapper importing these
+functions so its CLI, output shape and 0/1 exit-code contract are
+unchanged. The functions keep their legacy "file:line: message" string
+output — the GL001 rule class adapts them to Findings.
+
+Sub-rules (each a ``check_*`` function, all both-directions unless noted):
+
+* help-registry  — METRIC_HELP <-> METRIC_NAMES (Prometheus # HELP contract)
+* literals       — every literal ``.event/.span/.counter/.gauge/.histogram``
+                   name in the scanned trees is registered (events/spans/
+                   metrics), plus literal ``numeric_checkpoint`` call sites
+* resource-attrs — obs/resource.py ``*_ATTR`` <-> RESOURCE_SPAN_ATTRS
+* numerics       — obs/fingerprint.py ``*_CKPT``/``*_ATTR`` <->
+                   NUMERIC_CHECKPOINTS/NUMERIC_SPAN_ATTRS; parity_audit
+                   literals registered-only
+* consensus      — consensus/pipeline.py ``*_ATTR`` <-> CONSENSUS_SPAN_ATTRS
+* fault-sites    — resilience/inject.py ``*_SITE`` <-> FAULT_SITES;
+                   chaos_audit "site:kind" spec literals registered-only
+* work-ledger    — obs/ledger.py ``*_WORK`` <-> WORK_LEDGER_COUNTERS
+                   (subset of METRIC_NAMES) + bench.py/perf_history fallback
+                   literals ast-pinned to obs.ledger
+* snn-impls      — ops/pallas_snn.py ``*_SNN_IMPL`` <-> SNN_IMPLS +
+                   cluster/engine.py dispatch tuple pin
+* flight-alerts  — obs/alerts.py ``*_ALERT`` <-> ALERT_RULES and
+                   obs/flight.py ``*_FLIGHT`` <-> FLIGHT_EVENT_KINDS;
+                   cross-module consumers registered-only
+
+Why this is a lint rule: a typo'd metric name is a silently absent time
+series, a renamed fault site is a chaos audit that silently stops covering
+a failure mode. The registries make the whole drift class a test failure.
+A noqa is never acceptable here — fix the registry or the literal.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from consensusclustr_tpu.obs import schema  # noqa: E402
+
+from tools.graftlint.core import Finding, Rule, register  # noqa: E402
+
+EVENT_RE = re.compile(r"""\.event\(\s*["']([A-Za-z0-9_]+)["']""")
+SPAN_RE = re.compile(r"""\.span\(\s*["']([A-Za-z0-9_]+)["']""")
+MAYBE_SPAN_RE = re.compile(
+    r"""maybe_span\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
+)
+METRIC_RE = re.compile(
+    r"""\.(counter|gauge|histogram)\(\s*["']([A-Za-z0-9_]+)["']"""
+)
+# obs/resource.py + obs/fingerprint.py span-attr constants:
+# NAME_ATTR = "literal" at module level
+ATTR_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_ATTR)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# obs/fingerprint.py checkpoint-name constants: NAME_CKPT = "literal"
+CKPT_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_CKPT)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# resilience/inject.py fault-site constants: NAME_SITE = "literal"
+SITE_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_SITE)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# obs/ledger.py work-counter constants: NAME_WORK = "literal"
+WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# ops/pallas_snn.py SNN-impl constants: NAME_SNN_IMPL = "literal"
+SNN_IMPL_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_SNN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# obs/alerts.py alert-rule constants: NAME_ALERT = "literal"
+ALERT_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_ALERT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# obs/flight.py dump-reason constants: NAME_FLIGHT = "literal"
+FLIGHT_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_FLIGHT)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# literal site names at fault-spec strings in tools/chaos_audit.py presets:
+# "site:kind[:arg]" — the first segment must be a registered fault site
+SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
+# literal checkpoint names at numeric_checkpoint(...) call sites (package
+# call sites import the *_CKPT constants, but a literal must still resolve)
+CKPT_CALL_RE = re.compile(
+    r"""numeric_checkpoint\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
+)
+
+# Scanned trees/files, relative to the repo root. Tests are exempt (they
+# exercise the machinery with throwaway names on purpose). The package walk
+# covers every subpackage — serve/ (the online-assignment subsystem, ISSUE 3)
+# included; tests/test_serve.py pins that coverage so a future repo
+# reorganisation cannot silently drop it. Standalone drivers that emit or
+# read instrumentation by literal name are listed explicitly: serve_demo.py
+# (ISSUE 3) and loadgen.py (ISSUE 7 — its /metrics parity check reads
+# histograms by name; a typo'd literal there would silently parity-check
+# an always-empty series).
+SCAN = (
+    "consensusclustr_tpu",
+    "bench.py",
+    os.path.join("tools", "serve_demo.py"),
+    os.path.join("tools", "loadgen.py"),
+    # ISSUE 8: the parity auditor consumes checkpoint streams by name — a
+    # typo'd literal there would audit an always-empty stage
+    os.path.join("tools", "parity_audit.py"),
+    # ISSUE 10: the chaos auditor plants faults by site name — a typo'd
+    # site there would "prove" resilience by never firing
+    os.path.join("tools", "chaos_audit.py"),
+)
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for target in SCAN:
+        path = os.path.join(root, target)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, _, names in os.walk(path):
+            out.extend(
+                os.path.join(dirpath, n) for n in names if n.endswith(".py")
+            )
+    return sorted(out)
+
+
+def check_help_registry() -> List[str]:
+    """METRIC_HELP <-> METRIC_NAMES consistency (the Prometheus # HELP
+    contract): every registered metric documented, every help entry
+    registered."""
+    errors: List[str] = []
+    help_map = getattr(schema, "METRIC_HELP", None)
+    if help_map is None:
+        return ["obs/schema.py: METRIC_HELP registry is missing"]
+    for name in sorted(schema.METRIC_NAMES - set(help_map)):
+        errors.append(
+            f"obs/schema.py: metric {name!r} registered without METRIC_HELP "
+            "text (Prometheus # HELP would be empty)"
+        )
+    for name in sorted(set(help_map) - schema.METRIC_NAMES):
+        errors.append(
+            f"obs/schema.py: METRIC_HELP entry {name!r} not in METRIC_NAMES"
+        )
+    for name, text in sorted(help_map.items()):
+        if not str(text).strip():
+            errors.append(f"obs/schema.py: METRIC_HELP for {name!r} is empty")
+    return errors
+
+
+def _scan_constants(path: str, regex) -> dict:
+    """{literal: (CONST_NAME, lineno)} for module-level constants matching
+    ``regex`` in ``path``."""
+    found: dict = {}
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = regex.match(line)
+            if m:
+                found[m.group(2)] = (m.group(1), lineno)
+    return found
+
+
+def _check_constant_registry(
+    root: str,
+    rel: str,
+    regex,
+    registry_name: str,
+    kind: str,
+    require_complete: bool,
+) -> List[str]:
+    """Module-level constant literals in ``rel`` <-> the ``registry_name``
+    set in obs/schema.py. Every literal must be registered; with
+    ``require_complete`` every registry entry must also be backed by a
+    literal in ``rel`` (the defining module). Roots missing ``rel`` (the
+    synthetic trees the tests build) have nothing to validate and pass
+    clean."""
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return []
+    registry = getattr(schema, registry_name, None)
+    if registry is None:
+        return [f"obs/schema.py: {registry_name} registry is missing"]
+    errors: List[str] = []
+    found = _scan_constants(path, regex)
+    for name, (const, lineno) in sorted(found.items()):
+        if name not in registry:
+            errors.append(
+                f"{rel}:{lineno}: {kind} {name!r} ({const}) not in "
+                f"obs.schema.{registry_name}"
+            )
+    if require_complete:
+        for name in sorted(set(registry) - set(found)):
+            errors.append(
+                f"obs/schema.py: {registry_name} entry {name!r} has no "
+                f"literal constant in {rel}"
+            )
+    return errors
+
+
+def check_resource_attrs(root: str) -> List[str]:
+    """obs/resource.py ``*_ATTR`` literals <-> schema.RESOURCE_SPAN_ATTRS,
+    both directions: every literal registered, every registered attr backed
+    by a literal."""
+    return _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "obs", "resource.py"),
+        ATTR_RE, "RESOURCE_SPAN_ATTRS", "span attr", require_complete=True,
+    )
+
+
+def check_numeric_registry(root: str) -> List[str]:
+    """ISSUE 8: the numerics registries, both directions.
+
+    * obs/fingerprint.py ``*_CKPT`` literals <-> schema.NUMERIC_CHECKPOINTS
+      (complete: every registered checkpoint must have a defining constant —
+      call sites import these, so an unbacked registry entry means a
+      checkpoint nothing can stamp);
+    * obs/fingerprint.py ``*_ATTR`` literals <-> schema.NUMERIC_SPAN_ATTRS
+      (complete, same contract as the resource attrs);
+    * tools/parity_audit.py ``*_CKPT`` literals must be registered (not
+      complete — the auditor consumes streams, it defines no checkpoints).
+    """
+    fp_rel = os.path.join("consensusclustr_tpu", "obs", "fingerprint.py")
+    audit_rel = os.path.join("tools", "parity_audit.py")
+    errors = _check_constant_registry(
+        root, fp_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, fp_rel, ATTR_RE, "NUMERIC_SPAN_ATTRS", "span attr",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, audit_rel, CKPT_RE, "NUMERIC_CHECKPOINTS", "checkpoint",
+        require_complete=False,
+    )
+    return errors
+
+
+def check_consensus_attrs(root: str) -> List[str]:
+    """ISSUE 9: consensus/pipeline.py ``*_ATTR`` literals (the regime
+    provenance stamped on the candidates/cocluster spans) <->
+    schema.CONSENSUS_SPAN_ATTRS, both directions — a renamed regime attr is
+    a test failure, not a silently empty "== consensus ==" table in
+    tools/report.py."""
+    return _check_constant_registry(
+        root,
+        os.path.join("consensusclustr_tpu", "consensus", "pipeline.py"),
+        ATTR_RE, "CONSENSUS_SPAN_ATTRS", "span attr", require_complete=True,
+    )
+
+
+def check_fault_sites(root: str) -> List[str]:
+    """ISSUE 10: the fault-site registry, both directions.
+
+    * resilience/inject.py ``*_SITE`` literals <-> schema.FAULT_SITES
+      (complete: every registered site must have a defining constant — call
+      sites import these, so an unbacked registry entry means a site nothing
+      can plant);
+    * tools/chaos_audit.py fault-spec literals ("site:kind") must name
+      registered sites (not complete — the auditor consumes sites).
+    """
+    errors = _check_constant_registry(
+        root,
+        os.path.join("consensusclustr_tpu", "resilience", "inject.py"),
+        SITE_RE, "FAULT_SITES", "fault site", require_complete=True,
+    )
+    audit = os.path.join(root, "tools", "chaos_audit.py")
+    registry = getattr(schema, "FAULT_SITES", frozenset())
+    if os.path.isfile(audit):
+        with open(audit, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in SITE_SPEC_RE.finditer(line):
+                    if m.group(1) not in registry:
+                        errors.append(
+                            f"tools/chaos_audit.py:{lineno}: fault site "
+                            f"{m.group(1)!r} not in obs.schema.FAULT_SITES"
+                        )
+    return errors
+
+
+def _literal_assign(path: str, name: str):
+    """The literal value of a module-level ``name = <literal>`` assignment in
+    ``path`` (via ast — the file is never imported), or None when absent or
+    non-literal."""
+    import ast
+
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if name in targets:
+                try:
+                    return ast.literal_eval(node.value)
+                except ValueError:
+                    return None
+    return None
+
+
+def check_work_ledger(root: str) -> List[str]:
+    """ISSUE 12: the work-ledger registry, three ways.
+
+    * obs/ledger.py ``*_WORK`` literals <-> schema.WORK_LEDGER_COUNTERS
+      (complete: every registered counter must have a defining constant —
+      the ledger harvests by these names, so an unbacked registry entry is
+      a counter nothing sums);
+    * WORK_LEDGER_COUNTERS must be a subset of METRIC_NAMES — the ledger
+      only sums counters the metrics registry already owns, so a ledger
+      entry outside METRIC_NAMES would read a series nothing increments;
+    * bench.py's import-failure fallbacks (``_DISPATCH_FALLBACK`` /
+      ``_LEDGER_FALLBACK``) and tools/perf_history.py's
+      ``FLAT_LEDGER_KEYS`` are pinned (via ast, never imported) to
+      obs.ledger's ``BENCH_DISPATCH_KEYS`` / ``LEDGER_COUNTERS`` — the
+      failure-payload rung must stay key-identical to the real rungs even
+      when the package cannot import. Roots without bench.py (the
+      synthetic trees the tests build) skip the pinning.
+    """
+    errors = _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "obs", "ledger.py"),
+        WORK_RE, "WORK_LEDGER_COUNTERS", "work counter", require_complete=True,
+    )
+    registry = getattr(schema, "WORK_LEDGER_COUNTERS", None)
+    if registry is not None:
+        for name in sorted(set(registry) - schema.METRIC_NAMES):
+            errors.append(
+                f"obs/schema.py: WORK_LEDGER_COUNTERS entry {name!r} not in "
+                "METRIC_NAMES (the ledger would sum a series nothing "
+                "increments)"
+            )
+    if not os.path.isfile(
+        os.path.join(root, "consensusclustr_tpu", "obs", "ledger.py")
+    ):
+        return errors
+    try:
+        from consensusclustr_tpu.obs import ledger
+    except Exception as e:  # pragma: no cover - import breakage is its own bug
+        return errors + [f"obs/ledger.py: import failed ({e})"]
+    pins = (
+        ("bench.py", "_DISPATCH_FALLBACK", dict(ledger.BENCH_DISPATCH_KEYS)),
+        ("bench.py", "_LEDGER_FALLBACK", tuple(ledger.LEDGER_COUNTERS)),
+        (os.path.join("tools", "perf_history.py"), "FLAT_LEDGER_KEYS",
+         dict(ledger.BENCH_DISPATCH_KEYS)),
+    )
+    for rel, const, want in pins:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        got = _literal_assign(path, const)
+        if got != want:
+            errors.append(
+                f"{rel}: {const} drifted from obs.ledger "
+                f"(got {got!r}, expected {want!r})"
+            )
+    return errors
+
+
+def check_snn_impls(root: str) -> List[str]:
+    """ISSUE 13: the SNN-implementation registry, both directions.
+
+    * ops/pallas_snn.py ``*_SNN_IMPL`` literals <-> schema.SNN_IMPLS
+      (complete: every registered impl must have a defining constant — the
+      dispatch vocabulary lives where the kernel does, so an unbacked
+      registry entry is an impl nothing can select);
+    * cluster/engine.py's ``SNN_IMPLS`` dispatch tuple is ast-pinned to the
+      registry (set equality) — resolve_snn_impl must accept exactly the
+      registered vocabulary.
+    """
+    errors = _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "ops", "pallas_snn.py"),
+        SNN_IMPL_RE, "SNN_IMPLS", "snn impl", require_complete=True,
+    )
+    engine = os.path.join(root, "consensusclustr_tpu", "cluster", "engine.py")
+    registry = getattr(schema, "SNN_IMPLS", None)
+    if registry is not None and os.path.isfile(engine):
+        got = _literal_assign(engine, "SNN_IMPLS")
+        if got is not None and set(got) != set(registry):
+            errors.append(
+                "consensusclustr_tpu/cluster/engine.py: SNN_IMPLS drifted "
+                f"from obs.schema.SNN_IMPLS (got {sorted(got)!r}, expected "
+                f"{sorted(registry)!r})"
+            )
+    return errors
+
+
+def check_flight_alerts(root: str) -> List[str]:
+    """ISSUE 14: the failure-layer registries, both directions.
+
+    * obs/alerts.py ``*_ALERT`` literals <-> schema.ALERT_RULES (complete:
+      every registered rule must have a defining constant — consumers
+      import these, so an unbacked registry entry is a rule nothing can
+      reference);
+    * obs/flight.py ``*_FLIGHT`` literals <-> schema.FLIGHT_EVENT_KINDS
+      (complete, same contract — dump reasons are the post-mortem
+      vocabulary);
+    * serve/service.py and the cross-module consumers (flight.py's
+      ``*_ALERT``, alerts.py's ``*_FLIGHT``) registered-only — they consume
+      the vocabulary, they define none of it.
+    """
+    alerts_rel = os.path.join("consensusclustr_tpu", "obs", "alerts.py")
+    flight_rel = os.path.join("consensusclustr_tpu", "obs", "flight.py")
+    service_rel = os.path.join("consensusclustr_tpu", "serve", "service.py")
+    errors = _check_constant_registry(
+        root, alerts_rel, ALERT_RE, "ALERT_RULES", "alert rule",
+        require_complete=True,
+    )
+    errors += _check_constant_registry(
+        root, flight_rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
+        require_complete=True,
+    )
+    for rel in (service_rel, flight_rel):
+        errors += _check_constant_registry(
+            root, rel, ALERT_RE, "ALERT_RULES", "alert rule",
+            require_complete=False,
+        )
+    for rel in (service_rel, alerts_rel):
+        errors += _check_constant_registry(
+            root, rel, FLIGHT_RE, "FLIGHT_EVENT_KINDS", "dump reason",
+            require_complete=False,
+        )
+    return errors
+
+
+def check(root: str) -> List[str]:
+    """All schema violations under ``root`` as "file:line: message" strings."""
+    errors: List[str] = (
+        check_help_registry()
+        + check_resource_attrs(root)
+        + check_numeric_registry(root)
+        + check_consensus_attrs(root)
+        + check_fault_sites(root)
+        + check_work_ledger(root)
+        + check_snn_impls(root)
+        + check_flight_alerts(root)
+    )
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                for m in EVENT_RE.finditer(line):
+                    if m.group(1) not in schema.EVENT_KINDS:
+                        errors.append(
+                            f"{rel}:{lineno}: event kind {m.group(1)!r} not in "
+                            "obs.schema.EVENT_KINDS"
+                        )
+                for regex in (SPAN_RE, MAYBE_SPAN_RE):
+                    for m in regex.finditer(line):
+                        if m.group(1) not in schema.SPAN_NAMES:
+                            errors.append(
+                                f"{rel}:{lineno}: span name {m.group(1)!r} not "
+                                "in obs.schema.SPAN_NAMES"
+                            )
+                for m in METRIC_RE.finditer(line):
+                    if m.group(2) not in schema.METRIC_NAMES:
+                        errors.append(
+                            f"{rel}:{lineno}: metric name {m.group(2)!r} "
+                            f"({m.group(1)}) not in obs.schema.METRIC_NAMES"
+                        )
+                for m in CKPT_CALL_RE.finditer(line):
+                    if m.group(1) not in getattr(
+                        schema, "NUMERIC_CHECKPOINTS", frozenset()
+                    ):
+                        errors.append(
+                            f"{rel}:{lineno}: checkpoint {m.group(1)!r} not "
+                            "in obs.schema.NUMERIC_CHECKPOINTS"
+                        )
+    return errors
+
+
+_LEGACY_LINE_RE = re.compile(r"^(\S+?):(\d+):\s*(.*)$")
+_LEGACY_FILE_RE = re.compile(r"^([^\s:]+\.py):\s*(.*)$")
+
+
+def _to_finding(err: str) -> Finding:
+    """Adapt a legacy "file:line: message" string to a Finding. Registry-
+    level messages ("obs/schema.py: ...") anchor at line 1."""
+    m = _LEGACY_LINE_RE.match(err)
+    if m:
+        return Finding("GL001", m.group(1), int(m.group(2)), m.group(3))
+    m = _LEGACY_FILE_RE.match(err)
+    if m:
+        return Finding("GL001", m.group(1), 1, m.group(2))
+    return Finding("GL001", "obs/schema.py", 1, err)
+
+
+@register
+class SchemaRegistryRule(Rule):
+    """Observability registries and source literals must agree, both ways.
+
+    The family of checks that grew inside tools/check_obs_schema.py across
+    ISSUEs 1-14, now individual sub-rules of GL001 (see this module's
+    docstring for the full list): event/span/metric literals vs
+    EVENT_KINDS/SPAN_NAMES/METRIC_NAMES, METRIC_HELP completeness, resource/
+    numeric/consensus span attrs, numeric checkpoints, fault sites, the
+    work ledger (including the bench.py/perf_history.py fallback-literal
+    ast pins), SNN impls and the flight/alert vocabularies.
+
+    Bug class: a typo'd metric is a silently absent time series; a renamed
+    fault site is a chaos audit that silently stops covering a failure
+    mode; a bench fallback literal that drifts makes the failure payload
+    schema-incomparable exactly when it matters. noqa is never acceptable —
+    register the name or fix the literal.
+    """
+
+    code = "GL001"
+    name = "obs-registry-drift"
+    scope = "project"
+
+    def check_project(self, ctx):
+        return [_to_finding(e) for e in check(ctx.root)]

@@ -1,0 +1,102 @@
+"""GL007 — broad excepts that swallow without logging or re-raising.
+
+Bug class: invisible failure. The PR 10 chaos audit and the PR 14 flight
+recorder both exist because failures that vanish silently are the most
+expensive kind — a ``except Exception: pass`` around a cache write hides
+disk-full for months; around a kernel probe it hides a Mosaic regression.
+The repo's convention (docs/perf.md) is that every swallow either logs
+through ``utils/log.py``, records an obs event, or re-raises after
+annotating.
+
+Flagged, in package files outside ``obs/`` (the flight recorder is the
+registered swallow layer — its handlers run inside the crash path where
+raising or logging can recurse): a handler catching everything (bare
+``except:``, ``except Exception``, ``except BaseException``, or a tuple
+containing either) whose body contains no ``raise`` and no logging-ish
+call — any call named ``debug``/``info``/``warning``/``warn``/``error``/
+``exception``/``critical``/``event``/``record`` or ``warnings.warn``.
+
+When is a noqa acceptable: a documented best-effort degrade where logging
+itself could fail or recurse (the logger's own handler, interpreter
+shutdown paths), or a probe whose failure *is* the signal and is recorded
+by the caller. The reason must say which. Otherwise: log it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.core import Finding, Rule, register
+
+_LOGGISH = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "event", "record",
+}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    names = []
+    for node in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _handled(h: ast.ExceptHandler) -> bool:
+    for node in ast.walk(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if name in _LOGGISH:
+                return True
+    return False
+
+
+@register
+class SilentExceptRule(Rule):
+    """Broad ``except`` swallowing without logging or re-raising.
+
+    Descends from the chaos-audit/flight-recorder lesson: silent failure
+    is the most expensive kind. Flags bare/``Exception``-wide handlers
+    whose body neither raises nor makes a logging-ish call (``utils/log``
+    logger methods, obs ``event``/``record``, ``warnings.warn``). The
+    obs/ layer is exempt (registered swallow sites in the crash path).
+    noqa for documented best-effort degrades where logging could recurse
+    or the failure is the caller-recorded signal — the reason must say
+    which.
+    """
+
+    code = "GL007"
+    name = "silent-except"
+
+    def applies_to(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return (
+            rel.startswith("consensusclustr_tpu/")
+            and not rel.startswith("consensusclustr_tpu/obs/")
+        )
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        out = []
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ExceptHandler):
+                if _is_broad(node) and not _handled(node):
+                    out.append(Finding(
+                        "GL007", pf.rel, node.lineno,
+                        "broad except swallows without logging or "
+                        "re-raising — failures here vanish; log via "
+                        "utils/log.py, record an obs event, or noqa a "
+                        "documented best-effort degrade",
+                    ))
+        return out

@@ -505,6 +505,22 @@ def metrics_summary(record: dict) -> str:
     return "\n".join(lines) if lines else "(no metrics)"
 
 
+def lint(record: dict) -> str:
+    """graftlint summary line (ISSUE 15): the ``lint`` block bench.py
+    stamps on every payload — violation count, committed-baseline size and
+    how many rules ran. Records without the block (pre-ISSUE-15, or a
+    RunRecord rather than a bench payload) render the placeholder —
+    absence is normal, never an error (same contract as the work table)."""
+    lb = record.get("lint")
+    if not isinstance(lb, dict):
+        return "(no lint block)"
+    return (
+        f"violations={lb.get('violations', 0)} "
+        f"baseline={lb.get('baseline_size', 0)} "
+        f"rules={lb.get('rules_run', 0)}"
+    )
+
+
 def render(record: dict) -> str:
     schema = record.get("schema")
     head = (
@@ -529,6 +545,7 @@ def render(record: dict) -> str:
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),
         "", "== alerts ==", alerts(record),
+        "", "== lint ==", lint(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",
     ]

@@ -257,6 +257,55 @@ def _work_ledger_block(tracer) -> dict:
     return _work_ledger_zero()
 
 
+# The program-attribution rung (ISSUE 16): per-counting_jit-program cost
+# rows (utils/compile_cache.py program_profile) travel on every payload so
+# bench_diff can gate a single program's bytes (--gate bytes:<program>) and
+# perf_history can see a silent shift between programs under a flat
+# aggregate. Top programs by est_bytes, shape buckets dropped for payload
+# leanness. The zero shape rides the failure rung, key-identical.
+_PROGRAM_PROFILE_TOP = 8
+
+
+def _program_profile_zero() -> dict:
+    """The ``program_profile`` zero shape: no rows, all totals 0 — emitted
+    on the failure rung so the per-program gate always has a key-identical
+    block to compare (tests/test_profiler.py pins the key parity)."""
+    return {
+        "programs": [],
+        "n_programs": 0,
+        "totals": {
+            "dispatches": 0,
+            "compiles": 0,
+            "est_flops": 0.0,
+            "est_bytes": 0.0,
+            "donated_bytes": 0,
+            "dispatch_wall_s": 0.0,
+        },
+    }
+
+
+def _program_snapshot():
+    """Registry snapshot marking a program-attribution window (or None when
+    the package cannot import — the block then falls back to zero)."""
+    try:
+        from consensusclustr_tpu.utils.compile_cache import program_registry
+
+        return program_registry()
+    except Exception:
+        return None
+
+
+def _program_profile_block(since=None) -> dict:
+    try:
+        from consensusclustr_tpu.utils.compile_cache import program_profile
+
+        return program_profile(
+            since=since, top=_PROGRAM_PROFILE_TOP, shapes=False
+        )
+    except Exception:
+        return _program_profile_zero()
+
+
 # The lint rung (ISSUE 15): graftlint's summary travels on every payload so
 # perf history records whether the gate was green at measurement time. The
 # zero shape rides the failure rung (and any environment where the framework
@@ -1100,6 +1149,9 @@ def _run() -> dict:
     # main() only fills keys a config didn't set itself (failure rung and
     # the non-default configs keep the historical process-wide window).
     flat0 = _dispatch_counters()
+    # per-program attribution shares the same headline window: rows below
+    # decompose exactly the est_flops/est_bytes deltas emitted above them
+    prog0 = _program_snapshot()
 
     # Mirror the production dense dispatch (consensus/pipeline.py): the
     # einsum regime streams counts through the donated accumulator during the
@@ -1190,6 +1242,9 @@ def _run() -> dict:
         "wall_s": round(dt, 3),
         "wall_trials": wall_trials,
         "work_ledger": ledger_block,
+        # evaluated before the sub-rungs below dispatch (source order), so
+        # the program rows cover exactly the headline window opened at prog0
+        "program_profile": _program_profile_block(prog0),
         # parity surface: the timed run's boot label rows (this rung has no
         # final consensus labels — the boot matrix IS its label output)
         "labels_fingerprint": _labels_fingerprint(timed_labels),
@@ -1353,6 +1408,9 @@ def main() -> None:
         payload["env_health"] = envh.block(probe_s)
         payload.setdefault("work_ledger", _work_ledger_zero())
         payload.setdefault("lint", _lint_block())
+        # configs that scoped their own program window keep it; everything
+        # else reports the process-wide attribution (since=None)
+        payload.setdefault("program_profile", _program_profile_block())
         # configs that scoped their own flat window (the default rung's
         # headline-workload bracket) keep it; everything else gets the
         # historical process-wide delta
@@ -1428,6 +1486,7 @@ def main() -> None:
             "env_health": envh.block(probe_s),
             "wall_trials": dict(_WALL_TRIALS_ZERO),
             "work_ledger": _work_ledger_zero(),
+            "program_profile": _program_profile_zero(),
             "lint": dict(_LINT_ZERO),
             **_dispatch_delta(dispatch0, _dispatch_counters()),
             **_resource_rung(sampler),

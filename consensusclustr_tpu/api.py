@@ -898,6 +898,14 @@ def consensus_clust(
     from consensusclustr_tpu.obs.resource import start_for as _start_sampler
 
     sampler = _start_sampler(tracer, cfg.resource_sample_ms)
+    # Sampling profiler (obs/profiler.py, ISSUE 16): off unless
+    # cfg.profile_hz / CCTPU_PROFILE_HZ arms it. Samples are tagged with
+    # each thread's open-span path and the folded hot stacks land in the
+    # RunRecord (schema v9); an armed profiler also rides any flight-
+    # recorder post-mortem written while the run is live.
+    from consensusclustr_tpu.obs.profiler import start_profiler_for
+
+    profiler = start_profiler_for(tracer, cfg.profile_hz)
     # Fault injection (resilience/inject.py, ISSUE 10): cfg.fault_inject
     # plants a deterministic fault spec for exactly this run's duration;
     # None is inert (env-planted CCTPU_FAULT_INJECT faults still apply).
@@ -911,6 +919,8 @@ def consensus_clust(
     finally:
         if sampler is not None:
             sampler.stop()
+        if profiler is not None:
+            profiler.stop()
 
 
 def _consensus_clust_run(
@@ -1073,6 +1083,9 @@ def _consensus_clust_run(
     # --- run record (obs/): span tree + events + metrics snapshot ---------
     if sampler is not None:
         sampler.stop()  # closing watermark lands in the record's series
+    profiler = getattr(tracer, "profiler", None)
+    if profiler is not None:
+        profiler.stop()  # folded stacks stay readable for the record
     record_device_memory(tracer.metrics)
     run_record = RunRecord.from_tracer(
         tracer, config=cfg, backend=default_backend()

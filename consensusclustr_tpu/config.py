@@ -192,6 +192,15 @@ class ClusterConfig:
     # rss_peak_bytes/device_peak_bytes watermark attrs and the RunRecord
     # carries the sample series (rendered as Perfetto counter tracks).
     resource_sample_ms: Optional[int] = None
+    # Sampling profiler (obs/profiler.py, ISSUE 16): host stack-sampling
+    # rate in Hz. None resolves CCTPU_PROFILE_HZ; unset/0 = OFF — the
+    # profiler thread never starts and span() pays one attribute check
+    # (the off-is-free pin). When on, samples are tagged with each
+    # thread's open-span path, the RunRecord carries the folded hot
+    # stacks (schema v9), and tools/flamegraph.py exports them as
+    # collapsed text or speedscope JSON. Per-program cost attribution is
+    # independent of this knob and always on.
+    profile_hz: Optional[float] = None
     # Resilience (resilience/, ISSUE 10): total attempts per fault site —
     # chunk dispatch, checkpoint read/write, serving warm-up/batch. None
     # resolves CCTPU_RETRY_ATTEMPTS (default 3); 1 = fail-fast (no retries).
@@ -293,6 +302,10 @@ class ClusterConfig:
             raise ValueError(
                 f"resource_sample_ms must be >= 0 (0 = off); got "
                 f"{self.resource_sample_ms}"
+            )
+        if self.profile_hz is not None and float(self.profile_hz) < 0:
+            raise ValueError(
+                f"profile_hz must be >= 0 (0 = off); got {self.profile_hz}"
             )
         if self.serve_metrics_port is not None and not (
             0 <= int(self.serve_metrics_port) <= 65535

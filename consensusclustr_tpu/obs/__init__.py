@@ -64,6 +64,19 @@ burn rate, counter monotonicity — ``schema.ALERT_RULES``) into
 ``alert_raised``/``alert_cleared`` events, the ``alerts_active`` gauge, and
 the ``/healthz`` body. ``RunRecord`` gains ``postmortem_path``/``alerts``
 (schema v8). Kill switch: ``CCTPU_NO_FLIGHT=1``.
+
+The profiling layer (ISSUE 16 tentpole, ``obs/profiler.py`` +
+``utils/compile_cache.py``) answers *which program and which stack*:
+per-program cost attribution is always on (every ``counting_jit`` entry
+point gets dispatches/compiles/est-flops/est-bytes/donated-bytes/dispatch-
+wall rows summing to the global counters, ``RunRecord.program_profile``,
+schema v9), while the span-tagged ``SamplingProfiler`` is opt-in
+(``CCTPU_PROFILE_HZ`` / ``ClusterConfig.profile_hz``; off is pinned free):
+a daemon thread folds ``sys._current_frames()`` into bounded weighted
+stacks prefixed with each thread's open-span path
+(``RunRecord.profile``), exported as collapsed-stack text or speedscope
+JSON by ``tools/flamegraph.py``, and ridden into ``postmortem.json`` by
+the flight recorder when armed.
 """
 
 from consensusclustr_tpu.obs.alerts import (
@@ -111,6 +124,13 @@ from consensusclustr_tpu.obs.metrics import (
     global_metrics,
     record_device_memory,
 )
+from consensusclustr_tpu.obs.profiler import (
+    SamplingProfiler,
+    active_profiles,
+    profiling,
+    resolve_profile_hz,
+    start_profiler_for,
+)
 from consensusclustr_tpu.obs.record import (
     RunRecord,
     config_fingerprint,
@@ -148,11 +168,13 @@ __all__ = [
     "ResourceSampler",
     "RunRecord",
     "SCHEMA_VERSION",
+    "SamplingProfiler",
     "SPAN_NAMES",
     "Span",
     "StallWatchdog",
     "Tracer",
     "WorkLedger",
+    "active_profiles",
     "array_fingerprint",
     "attach_alerts",
     "attach_flight",
@@ -172,11 +194,14 @@ __all__ = [
     "maybe_span",
     "metrics_of",
     "numeric_checkpoint",
+    "profiling",
     "prom_text_from_snapshot",
     "record_device_memory",
     "resolve_numerics",
+    "resolve_profile_hz",
     "resource_sampling",
     "stall_watch",
+    "start_profiler_for",
     "tracer_of",
     "write_chrome_trace",
 ]

@@ -77,7 +77,11 @@ MANUAL_FLIGHT = "manual"
 
 # Version of the dump layout itself (inside the obs SCHEMA_VERSION stamp):
 # bump when the postmortem.json key set changes shape.
-FLIGHT_DUMP_VERSION = 1
+# v2 (ISSUE 16): optional ``profile`` key — when a sampling profiler
+# (obs/profiler.py) is armed at dump time, its folded hot-stack summary
+# rides the dump so a stall post-mortem shows where the process was
+# actually spinning, not just where each thread stood at death.
+FLIGHT_DUMP_VERSION = 2
 
 # Ring capacities: recent-history tails, not archives — the RunRecord keeps
 # the full streams. ~256 events/spans is minutes of pipeline history and
@@ -276,6 +280,23 @@ class FlightRecorder:
                     "log_lines": list(self.log_lines),
                     "metrics": reg.snapshot(),
                 }
+                # armed sampling profilers ride the dump (dump layout v2);
+                # lazy + guarded — a dying process must not die harder
+                # because the profiler layer misbehaved
+                try:
+                    from consensusclustr_tpu.obs.profiler import (
+                        active_profiles,
+                    )
+
+                    profs = active_profiles(top=50)
+                    if profs:
+                        payload["profile"] = profs[0]
+                        if len(profs) > 1:
+                            payload["profile"]["extra_profilers"] = (
+                                len(profs) - 1
+                            )
+                except Exception:
+                    pass
                 d = os.path.dirname(path)
                 if d:
                     os.makedirs(d, exist_ok=True)

@@ -1,0 +1,255 @@
+"""Span-tagged sampling profiler (ISSUE 16 tentpole front 2).
+
+A stdlib-only daemon thread samples ``sys._current_frames()`` at
+``CCTPU_PROFILE_HZ`` and folds each thread's stack into a bounded weighted
+map of collapsed call paths. When a :class:`~consensusclustr_tpu.obs.tracer.
+Tracer` is attached, each sample is prefixed with that thread's current
+open-span path (``span:<name>`` frames), so a flamegraph shows *which phase*
+the host was spinning in, not just which function — the tracer tells you a
+span took 40 s, the profiler tells you the 40 s was spent inside
+``_harvest_cost`` re-lowering rather than in the dispatch itself.
+
+Opt-in and off by default: ``resolve_profile_hz`` treats an unset/zero knob
+as disabled, ``SamplingProfiler.start`` is a no-op when disabled, and the
+tracer's span path publishing only happens while a profiler is attached —
+the unarmed run does one attribute check per span push/pop and NOTHING else
+(the off-is-free pin in tests/test_profiler.py, PR 8/14 style).
+
+Memory is bounded: at most ``CCTPU_PROFILE_MAX_NODES`` distinct folded
+stacks are retained; samples landing on new stacks past the cap increment a
+``dropped`` counter instead of allocating. The per-frame depth is capped the
+same way the flight recorder caps thread stacks.
+
+Armed profilers register in a process-global list so the flight recorder
+(obs/flight.py) can ride the current summary into ``postmortem.json`` — a
+stall dump then shows where the process was actually spinning.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_MAX_NODES = 4096
+_FRAME_DEPTH_CAP = 64  # frames kept per sampled stack (leaf-most preserved)
+
+_active_lock = threading.Lock()
+_ACTIVE: List["SamplingProfiler"] = []
+
+
+def resolve_profile_hz(explicit: Optional[float] = None) -> float:
+    """Effective sampling rate in Hz: explicit argument (ClusterConfig)
+    wins, else the CCTPU_PROFILE_HZ environment knob, else 0.0 (off)."""
+    if explicit is not None:
+        try:
+            return max(0.0, float(explicit))
+        except (TypeError, ValueError):
+            return 0.0
+    raw = os.environ.get("CCTPU_PROFILE_HZ", "").strip().lower()
+    if raw in ("", "0", "off", "none", "no", "false"):
+        return 0.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 0.0
+
+
+def _resolve_max_nodes(explicit: Optional[int] = None) -> int:
+    if explicit is not None:
+        return max(16, int(explicit))
+    raw = os.environ.get("CCTPU_PROFILE_MAX_NODES", "").strip()
+    try:
+        return max(16, int(raw)) if raw else DEFAULT_MAX_NODES
+    except ValueError:
+        return DEFAULT_MAX_NODES
+
+
+class SamplingProfiler:
+    """Bounded folded-stack sampler over ``sys._current_frames()``.
+
+    Lifecycle mirrors obs/resource.py's ResourceSampler: construct with an
+    (optional) explicit rate, ``attach`` a tracer for span tagging,
+    ``start``/``stop`` the daemon thread; every step is a no-op when the
+    resolved rate is 0 so call sites never need to branch on the knob.
+    """
+
+    def __init__(self, hz: Optional[float] = None,
+                 max_nodes: Optional[int] = None) -> None:
+        self._hz = resolve_profile_hz(hz)
+        self._max_nodes = _resolve_max_nodes(max_nodes)
+        self._stacks: Dict[Tuple[str, ...], int] = {}
+        self._samples = 0
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # {thread_ident: open-span path} — shared with attached tracers,
+        # written by their span() push/pop, read at sample time
+        self.span_paths: Dict[int, str] = {}
+        self._tracers: List[object] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._hz > 0
+
+    @property
+    def hz(self) -> float:
+        return self._hz
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def attach(self, tracer) -> object:
+        """Publish ``tracer``'s open-span paths into this profiler
+        (idempotent, no-op when disabled). Returns the tracer."""
+        if tracer is None or not self.enabled:
+            return tracer
+        if getattr(tracer, "profiler", None) is self:
+            return tracer
+        tracer.profiler = self
+        publish = getattr(tracer, "publish_span_paths", None)
+        if publish is not None:
+            publish(self.span_paths)
+            self._tracers.append(tracer)
+        return tracer
+
+    def start(self) -> "SamplingProfiler":
+        if not self.enabled or self.running:
+            return self
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="cctpu-profiler", daemon=True
+        )
+        self._thread.start()
+        with _active_lock:
+            if self not in _ACTIVE:
+                _ACTIVE.append(self)
+        return self
+
+    def stop(self) -> None:
+        """Stop sampling, join the thread, detach span publishing. The
+        folded stacks survive — ``summary()`` stays valid after stop."""
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            self._stop_event.set()
+            thread.join(timeout=5)
+        with _active_lock:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        for tracer in self._tracers:
+            publish = getattr(tracer, "publish_span_paths", None)
+            if publish is not None:
+                publish(None)
+        self._tracers = []
+
+    def _loop(self) -> None:
+        interval = 1.0 / self._hz
+        me = threading.get_ident()
+        while not self._stop_event.wait(interval):
+            try:
+                self.sample_now(skip=me)
+            except Exception:
+                pass  # observability must never fail the profiled work
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample_now(self, skip: Optional[int] = None) -> None:
+        """Take one sample of every live thread (minus ``skip``, normally
+        the profiler thread itself). Public so tests and one-shot callers
+        can sample deterministically without the daemon thread."""
+        frames = sys._current_frames()
+        span_paths = self.span_paths
+        with self._lock:
+            self._samples += 1
+            for ident, frame in frames.items():
+                if ident == skip:
+                    continue
+                stack = _fold_stack(frame)
+                tag = span_paths.get(ident)
+                if tag:
+                    stack = tuple(
+                        f"span:{part}" for part in tag.split("/")
+                    ) + stack
+                if stack in self._stacks:
+                    self._stacks[stack] += 1
+                elif len(self._stacks) < self._max_nodes:
+                    self._stacks[stack] = 1
+                else:
+                    self._dropped += 1
+
+    # -- output --------------------------------------------------------------
+
+    def summary(self, top: Optional[int] = None) -> dict:
+        """The RunRecord ``profile`` block: folded stacks ranked by weight
+        (root-first frame lists), plus the sampling bookkeeping a reader
+        needs to judge coverage (samples taken, stacks dropped at the
+        node cap)."""
+        with self._lock:
+            items = sorted(
+                self._stacks.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+            n_unique = len(items)
+            if top is not None:
+                items = items[:top]
+            return {
+                "hz": self._hz,
+                "samples": self._samples,
+                "unique_stacks": n_unique,
+                "dropped": self._dropped,
+                "max_nodes": self._max_nodes,
+                "stacks": [
+                    {"frames": list(frames), "weight": weight}
+                    for frames, weight in items
+                ],
+            }
+
+
+def _fold_stack(frame) -> Tuple[str, ...]:
+    """Collapse one frame chain into root-first ``file.py:function`` parts,
+    leaf-most _FRAME_DEPTH_CAP frames kept."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < _FRAME_DEPTH_CAP:
+        code = f.f_code
+        parts.append(f"{os.path.basename(code.co_filename)}:{code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return tuple(parts)
+
+
+def active_profiles(top: int = 50) -> List[dict]:
+    """Summaries of every armed profiler — what the flight recorder rides
+    into postmortem.json so a stall dump shows the hot stacks."""
+    with _active_lock:
+        profs = list(_ACTIVE)
+    return [p.summary(top=top) for p in profs]
+
+
+def start_profiler_for(tracer, hz: Optional[float] = None
+                       ) -> Optional[SamplingProfiler]:
+    """Arm a profiler for ``tracer`` when the resolved rate is non-zero;
+    returns the running profiler, or None when profiling is off (the
+    caller's stop path can just ``if prof: prof.stop()``)."""
+    prof = SamplingProfiler(hz=hz)
+    if not prof.enabled:
+        return None
+    prof.attach(tracer)
+    prof.start()
+    return prof
+
+
+@contextmanager
+def profiling(tracer=None, hz: Optional[float] = None):
+    """Context-managed arm/stop around a block (tests, ad-hoc scripts)."""
+    prof = start_profiler_for(tracer, hz=hz)
+    try:
+        yield prof
+    finally:
+        if prof is not None:
+            prof.stop()

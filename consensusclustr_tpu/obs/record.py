@@ -101,6 +101,16 @@ class RunRecord:
     # summary) — active alerts at record time, raise/clear totals, and the
     # last alert raised. None on older records and tracer-less runs.
     alerts: Optional[dict] = None
+    # schema v9: per-program cost attribution (utils/compile_cache.py
+    # program_profile) — ranked per-counting_jit-program rows whose
+    # est_flops/est_bytes sum to the global estimated_* counters. None on
+    # older records and runs that dispatched no counted program.
+    program_profile: Optional[dict] = None
+    # schema v9: sampling-profiler summary (obs/profiler.py
+    # SamplingProfiler.summary) — span-tagged folded hot stacks. None on
+    # older records and whenever CCTPU_PROFILE_HZ/profile_hz is off (the
+    # default: profiling is opt-in, attribution above is always-on).
+    profile: Optional[dict] = None
 
     @classmethod
     def from_tracer(
@@ -160,6 +170,27 @@ class RunRecord:
                 alerts = engine.summary()
             except Exception:
                 alerts = None
+        # per-program attribution is process-global (like the metrics
+        # registry merged above); lazy + guarded so this module stays
+        # importable without jax
+        program_profile = None
+        try:
+            from consensusclustr_tpu.utils.compile_cache import (
+                program_profile as _program_profile,
+            )
+
+            block = _program_profile()
+            if block.get("n_programs"):
+                program_profile = block
+        except Exception:
+            program_profile = None
+        profiler = getattr(tracer, "profiler", None)
+        profile = None
+        if profiler is not None:
+            try:
+                profile = profiler.summary(top=200)
+            except Exception:
+                profile = None
         return cls(
             schema=SCHEMA_VERSION,
             backend=backend,
@@ -174,6 +205,8 @@ class RunRecord:
             work_ledger=work_ledger,
             postmortem_path=postmortem_path,
             alerts=alerts,
+            program_profile=program_profile,
+            profile=profile,
         )
 
     def phase_seconds(self) -> Dict[str, float]:
@@ -206,6 +239,10 @@ class RunRecord:
             d["postmortem_path"] = self.postmortem_path
         if self.alerts is not None:
             d["alerts"] = self.alerts
+        if self.program_profile is not None:
+            d["program_profile"] = self.program_profile
+        if self.profile is not None:
+            d["profile"] = self.profile
         return d
 
     def to_json(self) -> str:
@@ -252,6 +289,8 @@ class RunRecord:
             work_ledger=d.get("work_ledger"),
             postmortem_path=d.get("postmortem_path"),
             alerts=d.get("alerts"),
+            program_profile=d.get("program_profile"),
+            profile=d.get("profile"),
         )
 
 

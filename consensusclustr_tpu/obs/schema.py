@@ -98,7 +98,21 @@ from __future__ import annotations
 # Every bench rung — including the failure payload — now carries ``alerts``
 # and ``postmortem_path`` keys, and /healthz reports ``alerts_active`` /
 # ``last_alert``. See docs/quirks.md "Observability schema v7 → v8".
-SCHEMA_VERSION = 8
+# v9 (ISSUE 16): deep profiling — RunRecord gained the ``program_profile``
+# block (utils/compile_cache.py per-program cost attribution: for every
+# counting_jit entry point, dispatches / compiles / est_flops / est_bytes /
+# donated_bytes / host-side dispatch wall plus per-shape-bucket cost rows,
+# always on, rows summing to the global estimated_* counters by
+# construction) and the optional ``profile`` block (obs/profiler.py
+# span-tagged sampling profiler summary — opt-in via CCTPU_PROFILE_HZ /
+# ClusterConfig.profile_hz, off is pinned free). New registries below:
+# PROGRAM_NAMES (the decorated entry-point vocabulary) and
+# PROGRAM_PROFILE_FIELDS (the row field names), both validated by
+# tools/check_obs_schema.py / GL001. Every bench rung — including the
+# failure payload — now carries ``program_profile``; armed profiles ride
+# flight-recorder dumps as an optional ``profile`` key. See docs/quirks.md
+# "Observability schema v8 → v9".
+SCHEMA_VERSION = 9
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -344,6 +358,37 @@ WORK_LEDGER_COUNTERS = frozenset({
     "ckpt_quarantined",         # corrupt checkpoint chunks set aside
 })
 
+# Per-program cost-attribution vocabulary (ISSUE 16). PROGRAM_NAMES is the
+# closed set of counting_jit-decorated entry points — the programs a
+# ``program_profile`` block may name. tools/check_obs_schema.py
+# (check_program_registry) scans the package for counting_jit decorators and
+# validates both directions: an entry point not registered here fails lint
+# (an unattributable program), and a registered name with no decorated
+# definition fails lint (a ghost row the report would render forever).
+PROGRAM_NAMES = frozenset({
+    "_boot_batch",                       # consensus/pipeline.py boot hot path
+    "_consensus_grid_from_knn",          # consensus/pipeline.py grid sweep
+    "_accum_cocluster_counts",           # consensus/cocluster.py dense accum
+    "_accum_sparse_cocluster_counts",    # consensus/cocluster.py sparse accum
+    "_consensus_tail_sharded",           # parallel/step.py sharded tail
+    "distributed_consensus_step",        # parallel/step.py distributed step
+    "sharded_run_bootstraps",            # parallel/boots.py pmap boots
+    "sharded_run_bootstraps_granular",   # parallel/boots.py granular boots
+    "_null_stat_batch",                  # nulltest/null.py null statistics
+    "_assign_batch",                     # serve/assign.py serving assignment
+})
+
+# Field names of one program_profile row (utils/compile_cache.py ``*_PROG``
+# literals — validated there against this set, both directions).
+PROGRAM_PROFILE_FIELDS = frozenset({
+    "dispatches",       # executable launches attributed to the program
+    "compiles",         # traces (one per fresh shape bucket)
+    "est_flops",        # cost_analysis flops folded into the program's rows
+    "est_bytes",        # cost_analysis bytes accessed, same fold
+    "donated_bytes",    # operand bytes donated in place per dispatch
+    "dispatch_wall_s",  # cumulative host-side wall around the dispatch call
+})
+
 # Span attrs stamped by consensus/pipeline.py on the candidates/cocluster
 # spans (ISSUE 9 — the regime provenance tools/report.py's "== consensus =="
 # table renders). tools/check_obs_schema.py validates the ``*_ATTR``
@@ -525,6 +570,14 @@ ENV_KNOBS = {
     "CCTPU_POSTMORTEM_PATH": (
         "unset",
         "Exact file path for the flight-recorder post-mortem dump.",
+    ),
+    "CCTPU_PROFILE_HZ": (
+        "off",
+        "Sampling-profiler rate in Hz; 0/off/none disables (the default).",
+    ),
+    "CCTPU_PROFILE_MAX_NODES": (
+        "4096",
+        "Cap on distinct folded stacks the profiler retains; extras drop.",
     ),
     "CCTPU_RESOURCE_MAX_SAMPLES": (
         "4096",

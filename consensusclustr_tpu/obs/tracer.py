@@ -101,6 +101,10 @@ class Tracer:
         # span-close hooks (obs/resource.py watermark attribution): called
         # with the closed Span after ``seconds`` is set; exceptions swallowed
         self._span_close_hooks: List[Any] = []
+        # published {thread_ident: open-span-path} map — None unless a
+        # sampling profiler attached one (obs/profiler.py). The unarmed
+        # span() path pays exactly one attribute check (off-is-free pin).
+        self._span_paths: Optional[Dict[int, str]] = None
 
     @property
     def _stack(self) -> List[Span]:
@@ -116,6 +120,24 @@ class Tracer:
         uses this to stamp per-phase memory watermark attrs."""
         self._span_close_hooks.append(fn)
 
+    def publish_span_paths(self, mapping: Optional[Dict[int, str]]) -> None:
+        """Attach (detach with None) a shared {thread_ident: span-path} map
+        that ``span()`` keeps current on push/pop — the sampling profiler's
+        cross-thread view of the thread-local stacks (obs/profiler.py tags
+        samples with it). Only the armed path does dict work."""
+        self._span_paths = mapping
+
+    def _publish_path(self) -> None:
+        m = self._span_paths
+        if m is None:
+            return
+        ident = threading.get_ident()
+        stack = self._stack
+        if stack:
+            m[ident] = "/".join(s.name for s in stack)
+        else:
+            m.pop(ident, None)
+
     # -- spans ---------------------------------------------------------------
 
     @contextlib.contextmanager
@@ -128,6 +150,8 @@ class Tracer:
         )
         (self._stack[-1].children if self._stack else self.roots).append(sp)
         self._stack.append(sp)
+        if self._span_paths is not None:
+            self._publish_path()
         ann = None
         if self.annotate if annotate is None else annotate:
             try:
@@ -165,6 +189,8 @@ class Tracer:
                 except Exception:
                     pass  # observability must never fail the traced work
             self._stack.pop()
+            if self._span_paths is not None:
+                self._publish_path()
             if not self._stack:
                 # top-level phase timings ride the bucketed histogram path so
                 # RunRecords / /metrics can answer phase-duration quantiles

@@ -24,7 +24,9 @@ import hashlib
 import json
 import os
 import pickle
-from typing import Optional
+import threading
+import time
+from typing import Dict, Optional
 
 import jax
 
@@ -33,6 +35,141 @@ from consensusclustr_tpu.utils.backend import default_backend
 
 _done = False
 _cache_dir: Optional[str] = None  # resolved XLA cache dir once enabled
+
+
+# ---------------------------------------------------------------------------
+# Per-program cost attribution (ISSUE 16 tentpole front 1)
+# ---------------------------------------------------------------------------
+# The global counters above answer "how much did the run move"; this registry
+# answers "which jitted program moved it". Every counting_jit entry point gets
+# a row keyed by its function name (override with program_name=...), and every
+# increment the wrapper folds into the global metrics is folded into the
+# program's row at the same call site — so the rows sum to the global counters
+# by construction, not by reconciliation. Field names are *_PROG constants so
+# check_obs_schema/GL001 can pin them against obs.schema.PROGRAM_PROFILE_FIELDS
+# both ways, and the set of decorated entry points is pinned against
+# obs.schema.PROGRAM_NAMES (check_program_registry).
+
+DISPATCHES_PROG = "dispatches"
+COMPILES_PROG = "compiles"
+FLOPS_PROG = "est_flops"
+BYTES_PROG = "est_bytes"
+DONATED_PROG = "donated_bytes"
+WALL_PROG = "dispatch_wall_s"
+
+# summable numeric fields of one program row, in report/rank order
+_PROG_FIELDS = (
+    DISPATCHES_PROG,
+    COMPILES_PROG,
+    FLOPS_PROG,
+    BYTES_PROG,
+    DONATED_PROG,
+    WALL_PROG,
+)
+# per-shape-bucket sub-row fields (one bucket per fresh (shape, static) trace)
+_BUCKET_FIELDS = (COMPILES_PROG, FLOPS_PROG, BYTES_PROG)
+
+_prog_lock = threading.Lock()
+_programs: Dict[str, dict] = {}
+
+
+def _program_entry(name: str) -> dict:
+    # callers hold _prog_lock
+    entry = _programs.get(name)
+    if entry is None:
+        entry = {field: 0.0 for field in _PROG_FIELDS}
+        entry["shapes"] = {}
+        _programs[name] = entry
+    return entry
+
+
+def _shape_bucket_key(args, kwargs) -> str:
+    """One dispatch's shape signature: dtype[dims] per array leaf, in tree
+    order. Computed only on the fresh-compile path (compiles are rare)."""
+    parts = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            dims = ",".join(str(d) for d in leaf.shape)
+            parts.append(f"{leaf.dtype}[{dims}]")
+    return ";".join(parts) or "()"
+
+
+def program_registry() -> Dict[str, dict]:
+    """Deep-copied snapshot of the per-program registry (safe to mutate,
+    usable as a ``since=`` window marker for :func:`program_profile`)."""
+    with _prog_lock:
+        return {
+            name: {
+                **{f: entry[f] for f in _PROG_FIELDS},
+                "shapes": {k: dict(b) for k, b in entry["shapes"].items()},
+            }
+            for name, entry in _programs.items()
+        }
+
+
+def reset_program_registry() -> None:
+    """Drop all program rows (tests / bench isolation)."""
+    with _prog_lock:
+        _programs.clear()
+
+
+def _row_delta(cur: dict, base: dict) -> dict:
+    out = {f: cur.get(f, 0) - base.get(f, 0) for f in _PROG_FIELDS}
+    shapes = {}
+    base_shapes = base.get("shapes", {})
+    for key, bucket in cur.get("shapes", {}).items():
+        prior = base_shapes.get(key, {})
+        d = {f: bucket.get(f, 0) - prior.get(f, 0) for f in _BUCKET_FIELDS}
+        if any(d.values()):
+            shapes[key] = d
+    out["shapes"] = shapes
+    return out
+
+
+def program_profile(since: Optional[Dict[str, dict]] = None,
+                    top: Optional[int] = None,
+                    shapes: bool = True) -> dict:
+    """The RunRecord/bench ``program_profile`` block: per-program rows ranked
+    by ``est_bytes`` (the O7 axis), plus totals that match the global
+    ``estimated_*`` counter deltas over the same window by construction.
+
+    ``since`` narrows to activity after a :func:`program_registry` snapshot
+    (bench's headline window); ``top`` truncates the ranked rows (totals
+    still cover every program); ``shapes=False`` drops the per-bucket
+    sub-rows for lean payloads.
+    """
+    snap = program_registry()
+    if since:
+        snap = {
+            name: _row_delta(entry, since.get(name, {}))
+            for name, entry in snap.items()
+        }
+        snap = {
+            name: entry for name, entry in snap.items()
+            if any(entry[f] for f in _PROG_FIELDS)
+        }
+    totals = {f: 0.0 for f in _PROG_FIELDS}
+    rows = []
+    for name, entry in snap.items():
+        row = {"name": name}
+        for f in _PROG_FIELDS:
+            v = entry[f]
+            totals[f] += v
+            row[f] = int(v) if f in (DISPATCHES_PROG, COMPILES_PROG,
+                                     DONATED_PROG) else float(v)
+        if shapes:
+            row["shapes"] = {
+                k: {**b, COMPILES_PROG: int(b.get(COMPILES_PROG, 0))}
+                for k, b in entry.get("shapes", {}).items()
+            }
+        rows.append(row)
+    rows.sort(key=lambda r: (-r[BYTES_PROG], r["name"]))
+    n_programs = len(rows)
+    if top is not None:
+        rows = rows[:top]
+    for f in (DISPATCHES_PROG, COMPILES_PROG, DONATED_PROG):
+        totals[f] = int(totals[f])
+    return {"programs": rows, "n_programs": n_programs, "totals": totals}
 
 
 def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
@@ -62,11 +199,23 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
     path and its chunk drivers — not every small jit in the package, so
     bench deltas are stable, gateable program counts (tools/bench_diff.py
     ``--gate compiles:...`` / ``--gate rss:...``).
+
+    ISSUE 16: every increment is ALSO attributed to the wrapped program in
+    the per-program registry (``program_registry`` / ``program_profile``),
+    keyed by the function's name (override with ``program_name=...``), plus
+    per-program host-side dispatch wall and per-shape-bucket cost rows —
+    so "14.96 GB moved" decomposes into a ranked table whose rows sum to
+    the global counters by construction.
     """
     if fun is None:
         return functools.partial(
             counting_jit, donate_argnums=donate_argnums, **jit_kwargs
         )
+    prog = str(
+        jit_kwargs.pop("program_name", None)
+        or getattr(fun, "__name__", None)
+        or "<anonymous>"
+    )
     donate = tuple(donate_argnums)
     in_harvest = [False]  # cost-harvest re-lowering must not count as a compile
 
@@ -75,6 +224,8 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
         # runs once per jit cache entry (trace time), not per call
         if not in_harvest[0]:
             global_metrics().counter("executable_compiles").inc()
+            with _prog_lock:
+                _program_entry(prog)[COMPILES_PROG] += 1
         return fun(*args, **kwargs)
 
     jitted = jax.jit(_traced, donate_argnums=donate, **jit_kwargs)
@@ -105,16 +256,31 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
         except Exception:  # graftlint: noqa[GL007] cost analysis is an optional metric source, never a requirement
             return
         mets = global_metrics()
+        total = {FLOPS_PROG: 0.0, BYTES_PROG: 0.0}
         for entry in cost if isinstance(cost, (list, tuple)) else (cost,):
             if not isinstance(entry, dict):
                 continue
-            for counter, key in (
-                ("estimated_flops", "flops"),
-                ("estimated_bytes_accessed", "bytes accessed"),
+            for counter, key, field in (
+                ("estimated_flops", "flops", FLOPS_PROG),
+                ("estimated_bytes_accessed", "bytes accessed", BYTES_PROG),
             ):
                 v = entry.get(key)
                 if v is not None and float(v) > 0:
                     mets.counter(counter).inc(float(v))
+                    total[field] += float(v)
+        # fold the SAME values into the program row + its shape bucket, so
+        # the per-program table sums exactly to the global counters
+        bucket_key = _shape_bucket_key(args, kwargs)
+        with _prog_lock:
+            entry = _program_entry(prog)
+            entry[FLOPS_PROG] += total[FLOPS_PROG]
+            entry[BYTES_PROG] += total[BYTES_PROG]
+            bucket = entry["shapes"].setdefault(
+                bucket_key, {f: 0.0 for f in _BUCKET_FIELDS}
+            )
+            bucket[COMPILES_PROG] += 1
+            bucket[FLOPS_PROG] += total[FLOPS_PROG]
+            bucket[BYTES_PROG] += total[BYTES_PROG]
 
     @functools.wraps(fun)
     def wrapper(*args, **kwargs):
@@ -123,8 +289,8 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
             return fun(*args, **kwargs)  # inlining into an enclosing program
         mets = global_metrics()
         mets.counter("device_dispatches").inc()
+        nbytes = 0
         if donate:
-            nbytes = 0
             for i in donate:
                 if i < len(args):
                     for leaf in jax.tree_util.tree_leaves(args[i]):
@@ -134,7 +300,14 @@ def counting_jit(fun=None, *, donate_argnums=(), **jit_kwargs):
             size_before = jitted._cache_size()
         except Exception:  # graftlint: noqa[GL007] cache-size introspection uses private jax API; absence just skips the compile counter
             size_before = None
+        t0 = time.perf_counter()
         out = jitted(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        with _prog_lock:
+            entry = _program_entry(prog)
+            entry[DISPATCHES_PROG] += 1
+            entry[DONATED_PROG] += nbytes
+            entry[WALL_PROG] += wall
         if size_before is not None:
             try:
                 fresh_compile = jitted._cache_size() > size_before

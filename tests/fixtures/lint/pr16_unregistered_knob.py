@@ -1,0 +1,15 @@
+"""GL002 fixture (ISSUE 16): a profiler knob read but never registered.
+
+The deep-profiling layer added CCTPU_PROFILE_HZ / CCTPU_PROFILE_MAX_NODES
+to obs.schema.ENV_KNOBS; this module simulates the drift the rule exists
+to catch — a new CCTPU_PROFILE_* read that skipped the registry. The knob
+name below must stay OUT of ENV_KNOBS forever: the test copies this file
+into a synthetic package root and asserts GL002 exits 3 naming it.
+"""
+
+import os
+
+
+def sample_interval_s() -> float:
+    hz = float(os.environ.get("CCTPU_PROFILE_FOO", "0") or 0)
+    return 1.0 / hz if hz > 0 else 0.0

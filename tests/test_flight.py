@@ -459,7 +459,7 @@ class TestAlertRules:
 
 class TestSchemaV8:
     def test_registry_entries(self):
-        assert obs_schema.SCHEMA_VERSION == 8
+        assert obs_schema.SCHEMA_VERSION == 9
         for kind in (
             "stall_detected", "postmortem_dump", "alert_raised",
             "alert_cleared",
@@ -485,7 +485,7 @@ class TestSchemaV8:
             tr.metrics.counter("boots_completed").inc()
         tr.flight.dump(MANUAL_FLIGHT, path=rec_path)
         rec = RunRecord.from_tracer(tr)
-        assert rec.schema == 8
+        assert rec.schema == 9
         assert rec.postmortem_path == rec_path
         assert rec.alerts is not None and rec.alerts["active"] == {}
         path = str(tmp_path / "rec.jsonl")

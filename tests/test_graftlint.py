@@ -307,6 +307,36 @@ class TestEnvKnobRegistry:
         assert p.returncode == 0
         assert "already current" in p.stdout
 
+    def test_profiler_knobs_are_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        # ISSUE 16: the sampling-profiler knobs ride the registry like
+        # every other CCTPU_* read
+        for knob in ("CCTPU_PROFILE_HZ", "CCTPU_PROFILE_MAX_NODES"):
+            assert knob in schema.ENV_KNOBS
+
+    def test_unregistered_profiler_knob_exits_three(self, tmp_path):
+        # ISSUE 16 fixture: a CCTPU_PROFILE_* read that skipped ENV_KNOBS
+        # must trip GL002 at exit 3 naming the knob. Project-scope rules
+        # skip in explicit-paths mode, so build a synthetic package root
+        # around the fixture (same shape as the GL001 wrapper test above).
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        src = open(
+            _fixture("pr16_unregistered_knob.py"), encoding="utf-8"
+        ).read()
+        (pkg / "pr16_unregistered_knob.py").write_text(src)
+        res = core.run(
+            root=str(tmp_path), select=["GL002"], baseline_path=None
+        )
+        assert res.exit_code == 3
+        hits = [
+            f for f in res.violations
+            if f.code == "GL002" and "CCTPU_PROFILE_FOO" in f.message
+        ]
+        assert hits, [f.message for f in res.violations]
+        assert "pr16_unregistered_knob.py" in hits[0].path
+
 
 class TestCheckObsSchemaWrapper:
     """The thin wrapper keeps its import surface and CLI contract."""
@@ -325,8 +355,8 @@ class TestCheckObsSchemaWrapper:
         for attr in ("check", "check_help_registry", "check_resource_attrs",
                      "check_consensus_attrs", "check_fault_sites",
                      "check_work_ledger", "check_snn_impls",
-                     "check_flight_alerts", "_py_files", "SCAN", "schema",
-                     "main"):
+                     "check_flight_alerts", "check_program_registry",
+                     "PROG_RE", "_py_files", "SCAN", "schema", "main"):
             assert hasattr(mod, attr), attr
 
     def test_cli_clean_exit_zero(self):

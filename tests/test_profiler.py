@@ -1,0 +1,674 @@
+"""Deep-profiling layer (ISSUE 16): per-program cost attribution +
+span-tagged sampling profiler + flamegraph export (obs schema v9).
+
+Covers the checklist:
+
+* the per-program registry's sums-to-global invariant — the
+  ``program_profile`` totals equal the global counter deltas over the same
+  window, across pipeline depths 1/2/4 and the fused:looped grid pair;
+* the off-is-free pin (armed vs unarmed: identical assignments, identical
+  deterministic work ledger; the unarmed tracer publishes nothing);
+* profiler lifecycle (daemon thread start/stop, _ACTIVE registration) and
+  bounded memory (max_nodes cap + dropped counter under unique stacks);
+* span tagging (samples prefixed with the sampled thread's open-span path);
+* the schema v9 RunRecord round trip and the flight-recorder dump riding
+  an armed profile (flight_dump_version 2);
+* tools/flamegraph.py collapsed-stack text and structurally valid
+  speedscope JSON;
+* tools/report.py's ``== programs ==`` / ``== profile ==`` tables and
+  their pre-v9 placeholders;
+* bench.py's ``_program_profile_zero`` key parity with a real block.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.api import consensus_clust
+from consensusclustr_tpu.obs import RunRecord, Tracer, global_metrics
+from consensusclustr_tpu.obs import schema as obs_schema
+from consensusclustr_tpu.obs.profiler import (
+    SamplingProfiler,
+    active_profiles,
+    profiling,
+    resolve_profile_hz,
+    start_profiler_for,
+)
+from consensusclustr_tpu.utils.compile_cache import (
+    counting_jit,
+    program_profile,
+    program_registry,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tiny_pca(seed=5, n=96, d=6):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 6, size=(3, d))
+    return (
+        centers[rng.integers(0, 3, size=n)] + rng.normal(0, 1, (n, d))
+    ).astype(np.float32)
+
+
+_TINY_KW = dict(
+    pc_num=6, nboots=2, k_num=(5,), res_range=(0.3,), max_clusters=16,
+    test_significance=False,
+)
+
+# the global work-ledger counters each *_PROG field folds into, at the
+# same call sites — the invariant under test
+_COUNTER_OF_FIELD = {
+    "dispatches": "device_dispatches",
+    "compiles": "executable_compiles",
+    "est_flops": "estimated_flops",
+    "est_bytes": "estimated_bytes_accessed",
+    "donated_bytes": "donated_bytes",
+}
+
+
+def _global_counters():
+    mets = global_metrics()
+    return {
+        name: mets.counter(name).value for name in _COUNTER_OF_FIELD.values()
+    }
+
+
+# -----------------------------------------------------------------------------
+# knob resolution
+# -----------------------------------------------------------------------------
+
+
+class TestResolveHz:
+    def test_unset_is_off(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_PROFILE_HZ", raising=False)
+        assert resolve_profile_hz() == 0.0
+
+    @pytest.mark.parametrize("raw", ["", "0", "off", "none", "no", "false",
+                                     "OFF", "not-a-number"])
+    def test_disabling_spellings(self, monkeypatch, raw):
+        monkeypatch.setenv("CCTPU_PROFILE_HZ", raw)
+        assert resolve_profile_hz() == 0.0
+
+    def test_env_rate(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_PROFILE_HZ", "97")
+        assert resolve_profile_hz() == 97.0
+
+    def test_explicit_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_PROFILE_HZ", "97")
+        assert resolve_profile_hz(13.0) == 13.0
+        assert resolve_profile_hz(0) == 0.0  # explicit off beats env on
+
+    def test_negative_clamps_off(self):
+        assert resolve_profile_hz(-5) == 0.0
+
+
+# -----------------------------------------------------------------------------
+# profiler: lifecycle, bounded memory, span tagging
+# -----------------------------------------------------------------------------
+
+
+class TestSamplingProfiler:
+    def test_disabled_profiler_is_inert(self):
+        prof = SamplingProfiler(hz=0)
+        assert not prof.enabled
+        prof.start()
+        assert not prof.running
+        assert start_profiler_for(Tracer(), hz=0) is None
+
+    def test_lifecycle(self):
+        prof = SamplingProfiler(hz=200)
+        prof.start()
+        try:
+            assert prof.running
+            assert prof._thread.daemon
+            assert prof._thread.name == "cctpu-profiler"
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if prof.summary()["samples"] >= 3:
+                    break
+                time.sleep(0.01)
+            assert active_profiles()  # armed profiler visible to flight.py
+        finally:
+            prof.stop()
+        assert not prof.running
+        assert not active_profiles()
+        summ = prof.summary()
+        assert summ["samples"] >= 3 and summ["stacks"]  # survives stop
+
+    def test_bounded_memory(self):
+        prof = SamplingProfiler(hz=100, max_nodes=16)
+
+        def recurse(depth):
+            if depth == 0:
+                prof.sample_now()
+                return
+            recurse(depth - 1)
+
+        for depth in range(40):  # 40 distinct stack shapes
+            recurse(depth)
+        summ = prof.summary()
+        assert summ["unique_stacks"] <= 16
+        assert summ["max_nodes"] == 16
+        assert summ["dropped"] > 0
+        assert summ["samples"] == 40
+
+    def test_span_tagging(self):
+        prof = SamplingProfiler(hz=100)
+        tr = Tracer()
+        prof.attach(tr)
+        assert getattr(tr, "profiler", None) is prof
+        with tr.span("boots"):
+            with tr.span("boot_chunk"):
+                prof.sample_now()
+        prof.stop()  # detaches publishing
+        assert tr._span_paths is None
+        tagged = [
+            s for s in prof.summary()["stacks"]
+            if s["frames"][:2] == ["span:boots", "span:boot_chunk"]
+        ]
+        assert tagged, prof.summary()["stacks"]
+
+    def test_summary_top_truncates_but_counts_all(self):
+        prof = SamplingProfiler(hz=100)
+
+        def recurse(depth):
+            if depth == 0:
+                prof.sample_now()
+                return
+            recurse(depth - 1)
+
+        for depth in range(8):
+            recurse(depth)
+        summ = prof.summary(top=3)
+        assert len(summ["stacks"]) == 3
+        assert summ["unique_stacks"] >= 8
+
+    def test_profiling_contextmanager(self):
+        with profiling(hz=0) as prof:
+            assert prof is None
+        with profiling(hz=300) as prof:
+            assert prof is not None and prof.running
+        assert not prof.running
+
+
+# -----------------------------------------------------------------------------
+# per-program attribution: sums-to-global invariant
+# -----------------------------------------------------------------------------
+
+
+class TestProgramAttribution:
+    def test_counting_jit_attributes_to_named_program(self):
+        @counting_jit(program_name="_boot_batch")
+        def _probe(x):
+            return x * 2.0
+
+        before = program_registry()
+        _probe(jnp.ones((4,), jnp.float32))
+        _probe(jnp.ones((4,), jnp.float32))
+        _probe(jnp.ones((8,), jnp.float32))  # second shape bucket
+        block = program_profile(since=before)
+        rows = {r["name"]: r for r in block["programs"]}
+        row = rows["_boot_batch"]
+        assert row["dispatches"] == 3
+        assert row["compiles"] == 2
+        assert isinstance(row["dispatches"], int)
+        assert row["dispatch_wall_s"] > 0
+        assert len(row["shapes"]) == 2  # one bucket per traced shape
+        for bucket in row["shapes"].values():
+            assert bucket["compiles"] == 1
+
+    @pytest.mark.parametrize(
+        "depth,grid_impl",
+        [(1, "fused"), (2, "fused"), (4, "fused"), (2, "looped")],
+    )
+    def test_sums_to_global(self, monkeypatch, depth, grid_impl):
+        """The tentpole invariant: over any window, the program_profile
+        totals equal the global counter deltas — the rows are the global
+        counters, decomposed. Exact for the integer counters; the float
+        cost totals are folded from identical values at identical call
+        sites, so they match to float tolerance."""
+        monkeypatch.setenv("CCTPU_GRID_IMPL", grid_impl)
+        before_counters = _global_counters()
+        before_registry = program_registry()
+        res = consensus_clust(
+            pca=_tiny_pca(seed=20 + depth), pipeline_depth=depth, **_TINY_KW
+        )
+        block = program_profile(since=before_registry)
+        deltas = {
+            name: val - before_counters[name]
+            for name, val in _global_counters().items()
+        }
+        assert deltas["device_dispatches"] > 0
+        for field, counter in _COUNTER_OF_FIELD.items():
+            got, want = block["totals"][field], deltas[counter]
+            if field in ("dispatches", "compiles", "donated_bytes"):
+                assert got == want, (field, got, want)
+            else:
+                assert got == pytest.approx(want, rel=1e-6), (field, got, want)
+        # every program the run touched is a registered entry point, and
+        # each row carries exactly the registered field set
+        for row in block["programs"]:
+            assert row["name"] in obs_schema.PROGRAM_NAMES
+            assert set(row) - {"name", "shapes"} == set(
+                obs_schema.PROGRAM_PROFILE_FIELDS
+            )
+        assert res.run_record.program_profile is not None
+
+    def test_headline_accounts_for_global_counters(self):
+        """ISSUE 16 acceptance: the ranked table accounts for >= 95% of the
+        global est_bytes/est_flops moved in the window (it is 100% by
+        construction; 95% is the gate)."""
+        before_counters = _global_counters()
+        before_registry = program_registry()
+        consensus_clust(pca=_tiny_pca(seed=77), **_TINY_KW)
+        block = program_profile(since=before_registry)
+        deltas = {
+            name: val - before_counters[name]
+            for name, val in _global_counters().items()
+        }
+        for field, counter in (("est_bytes", "estimated_bytes_accessed"),
+                               ("est_flops", "estimated_flops")):
+            if deltas[counter] <= 0:
+                continue  # warm cache: nothing compiled, nothing to split
+            covered = sum(r[field] for r in block["programs"])
+            assert covered >= 0.95 * deltas[counter]
+
+
+# -----------------------------------------------------------------------------
+# off-is-free + the armed pipeline run
+# -----------------------------------------------------------------------------
+
+
+class TestOffIsFree:
+    def test_off_is_free(self, monkeypatch):
+        """Unarmed (the default) vs armed at 250 Hz: identical assignments,
+        identical deterministic work ledger — sampling reads stacks, it
+        never perturbs the counted work. The unarmed run publishes no span
+        paths and carries no profile block."""
+        monkeypatch.delenv("CCTPU_PROFILE_HZ", raising=False)
+        kw = dict(pca=_tiny_pca(), **_TINY_KW)
+        consensus_clust(**kw)  # warmup: compiles on neither side's clock
+
+        off = consensus_clust(**kw)
+        armed = consensus_clust(profile_hz=250.0, **kw)
+
+        assert np.array_equal(armed.assignments, off.assignments)
+        wa = armed.run_record.work_ledger
+        wo = off.run_record.work_ledger
+        assert wa is not None and wa["counters"] == wo["counters"]
+        assert off.run_record.profile is None
+        prof = armed.run_record.profile
+        assert prof is not None and prof["hz"] == 250.0
+        assert prof["samples"] >= 1
+        # both carry the always-on attribution block
+        assert off.run_record.program_profile is not None
+        assert armed.run_record.program_profile is not None
+
+    def test_unarmed_tracer_publishes_nothing(self):
+        tr = Tracer()
+        with tr.span("boots"):
+            assert tr._span_paths is None
+        assert getattr(tr, "profiler", None) is None
+
+
+# -----------------------------------------------------------------------------
+# schema v9 round trip + flight dump riding
+# -----------------------------------------------------------------------------
+
+
+class TestSchemaV9:
+    def test_registries(self):
+        assert obs_schema.SCHEMA_VERSION == 9
+        assert len(obs_schema.PROGRAM_NAMES) >= 10
+        assert "_boot_batch" in obs_schema.PROGRAM_NAMES
+        assert obs_schema.PROGRAM_PROFILE_FIELDS == frozenset(
+            ("dispatches", "compiles", "est_flops", "est_bytes",
+             "donated_bytes", "dispatch_wall_s")
+        )
+        for knob in ("CCTPU_PROFILE_HZ", "CCTPU_PROFILE_MAX_NODES"):
+            assert knob in obs_schema.ENV_KNOBS
+
+    def test_config_validates_profile_hz(self):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        assert ClusterConfig(profile_hz=50.0).profile_hz == 50.0
+        with pytest.raises(ValueError):
+            ClusterConfig(profile_hz=-1.0)
+
+    def _record_with_profile(self):
+        @counting_jit(program_name="_boot_batch")
+        def _probe(x):
+            return x + 1.0
+
+        tr = Tracer()
+        prof = SamplingProfiler(hz=100)
+        prof.attach(tr)
+        with tr.span("boots"):
+            _probe(jnp.ones((3,), jnp.float32))
+            prof.sample_now()
+        prof.stop()
+        return RunRecord.from_tracer(tr)
+
+    def test_record_round_trip(self, tmp_path):
+        rec = self._record_with_profile()
+        assert rec.schema == 9
+        assert rec.program_profile is not None
+        assert rec.profile is not None and rec.profile["stacks"]
+        path = str(tmp_path / "rec.jsonl")
+        rec.write(path)
+        from consensusclustr_tpu.obs import load_records
+
+        back = load_records(path)[-1]
+        assert back.schema == 9
+        assert back.program_profile == rec.program_profile
+        assert back.profile == rec.profile
+
+    def test_dump_rides_armed_profile(self, tmp_path):
+        from consensusclustr_tpu.obs.flight import (
+            FLIGHT_DUMP_VERSION,
+            MANUAL_FLIGHT,
+            FlightRecorder,
+        )
+
+        assert FLIGHT_DUMP_VERSION == 2
+        fr = FlightRecorder(attach_log_handler=False)
+        prof = SamplingProfiler(hz=100)
+        prof.start()  # registration, not sampling, is what the dump reads
+        try:
+            prof.sample_now()
+            path = str(tmp_path / "postmortem.json")
+            fr.dump(MANUAL_FLIGHT, path=path)
+        finally:
+            prof.stop()
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["flight_dump_version"] == 2
+        assert isinstance(dump.get("profile"), dict)
+        assert dump["profile"]["hz"] == 100.0
+
+    def test_dump_without_profiler_has_no_profile_key(self, tmp_path):
+        from consensusclustr_tpu.obs.flight import (
+            MANUAL_FLIGHT,
+            FlightRecorder,
+        )
+
+        fr = FlightRecorder(attach_log_handler=False)
+        path = str(tmp_path / "postmortem.json")
+        fr.dump(MANUAL_FLIGHT, path=path)
+        with open(path) as f:
+            dump = json.load(f)
+        assert "profile" not in dump
+
+
+# -----------------------------------------------------------------------------
+# tools: flamegraph export, report tables, bench parity
+# -----------------------------------------------------------------------------
+
+
+def _fake_profile():
+    return {
+        "hz": 50.0, "samples": 10, "unique_stacks": 2, "dropped": 0,
+        "max_nodes": 4096,
+        "stacks": [
+            {"frames": ["span:consensus_cluster", "span:boots",
+                        "api.py:run", "pipeline.py:chunk"], "weight": 7},
+            {"frames": ["api.py:run", "pipeline.py:tail"], "weight": 3},
+        ],
+    }
+
+
+class TestFlamegraphTool:
+    def _record_path(self, tmp_path, profile=True):
+        rec = {"schema": 9, "events": [], "spans": [], "metrics": {}}
+        if profile:
+            rec["profile"] = _fake_profile()
+        path = str(tmp_path / "rec.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        return path
+
+    def test_collapsed_output(self, tmp_path, capsys):
+        fg = _load_tool("flamegraph")
+        assert fg.main([self._record_path(tmp_path)]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out[0] == (
+            "span:consensus_cluster;span:boots;api.py:run;pipeline.py:chunk 7"
+        )
+        assert out[1] == "api.py:run;pipeline.py:tail 3"
+
+    def test_no_profile_exits_one(self, tmp_path, capsys):
+        fg = _load_tool("flamegraph")
+        assert fg.main([self._record_path(tmp_path, profile=False)]) == 1
+        assert "CCTPU_PROFILE_HZ" in capsys.readouterr().err
+
+    def test_speedscope_structure(self, tmp_path):
+        fg = _load_tool("flamegraph")
+        out = str(tmp_path / "prof.speedscope.json")
+        rc = fg.main([self._record_path(tmp_path), "--speedscope", out])
+        assert rc == 0
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["$schema"] == (
+            "https://www.speedscope.app/file-format-schema.json"
+        )
+        frames = doc["shared"]["frames"]
+        prof = doc["profiles"][doc["activeProfileIndex"]]
+        assert prof["type"] == "sampled"
+        assert len(prof["samples"]) == len(prof["weights"]) == 2
+        for sample in prof["samples"]:
+            assert all(0 <= ix < len(frames) for ix in sample)
+        assert sum(prof["weights"]) == prof["endValue"] == 10
+        assert prof["startValue"] == 0
+        # frame table round-trips the folded names
+        names = [fr["name"] for fr in frames]
+        assert "span:consensus_cluster" in names
+
+    def test_real_summary_exports(self, tmp_path):
+        """End to end on a REAL profiler summary, not the fixture."""
+        prof = SamplingProfiler(hz=100)
+        prof.sample_now()
+        rec_path = str(tmp_path / "rec.jsonl")
+        with open(rec_path, "w") as f:
+            f.write(json.dumps(
+                {"schema": 9, "profile": prof.summary()}
+            ) + "\n")
+        fg = _load_tool("flamegraph")
+        out = str(tmp_path / "out.json")
+        assert fg.main([rec_path, "--speedscope", out, "--out",
+                        str(tmp_path / "collapsed.txt")]) == 0
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["profiles"][0]["weights"]
+
+
+class TestReportTables:
+    def _report(self):
+        return _load_tool("report")
+
+    def test_programs_table(self):
+        report = self._report()
+        rec = {
+            "schema": 9,
+            "program_profile": {
+                "programs": [
+                    {"name": "_boot_batch", "dispatches": 12, "compiles": 2,
+                     "est_flops": 2.5e9, "est_bytes": 1.5e9,
+                     "donated_bytes": 4096, "dispatch_wall_s": 0.5},
+                ],
+                "n_programs": 1,
+                "totals": {"dispatches": 12, "compiles": 2,
+                           "est_flops": 2.5e9, "est_bytes": 1.5e9,
+                           "donated_bytes": 4096, "dispatch_wall_s": 0.5},
+            },
+        }
+        out = report.programs(rec)
+        assert "_boot_batch" in out and "(total)" in out
+        assert report.programs({}) == (
+            "(no program attribution; schema < 9 record)"
+        )
+
+    def test_profile_table_and_placeholder(self):
+        report = self._report()
+        out = report.profile({"schema": 9, "profile": _fake_profile()})
+        assert "hz=50.0" in out
+        assert "consensus_cluster/boots" in out
+        assert report.profile({}) == (
+            "(no profile; arm with CCTPU_PROFILE_HZ / profile_hz)"
+        )
+
+    def test_render_includes_sections(self):
+        report = self._report()
+        assert 9 in report.KNOWN_SCHEMAS
+        rec = {"schema": 9, "events": [], "spans": [], "metrics": {}}
+        out = report.render(rec)
+        assert "== programs ==" in out and "== profile ==" in out
+
+
+class TestBenchParity:
+    def test_zero_block_key_parity(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO_ROOT, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        zero = bench._program_profile_zero()
+        real = program_profile(shapes=False)
+        assert set(zero) == set(real) == {
+            "programs", "n_programs", "totals",
+        }
+        assert set(zero["totals"]) == set(real["totals"]) == frozenset(
+            obs_schema.PROGRAM_PROFILE_FIELDS
+        )
+        assert zero["programs"] == [] and zero["n_programs"] == 0
+        assert all(v == 0 for v in zero["totals"].values())
+
+
+# -----------------------------------------------------------------------------
+# bench_diff: per-program bytes gate
+# -----------------------------------------------------------------------------
+
+
+class TestBenchDiffProgramGate:
+    def _payload(self, boot_bytes, schema=9):
+        return {
+            "metric": "mock", "value": 1.0, "unit": "x",
+            "obs_schema": schema,
+            "program_profile": {
+                "programs": [
+                    {"name": "_boot_batch", "dispatches": 4, "compiles": 1,
+                     "est_flops": 1.0, "est_bytes": boot_bytes,
+                     "donated_bytes": 0, "dispatch_wall_s": 0.1},
+                ],
+                "n_programs": 1,
+                "totals": {"dispatches": 4, "compiles": 1, "est_flops": 1.0,
+                           "est_bytes": boot_bytes, "donated_bytes": 0,
+                           "dispatch_wall_s": 0.1},
+            },
+        }
+
+    def _run(self, tmp_path, old, new, *args):
+        import subprocess
+        import sys
+
+        for name, payload in (("old.json", old), ("new.json", new)):
+            with open(tmp_path / name, "w") as f:
+                json.dump(payload, f)
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "bench_diff.py"),
+             str(tmp_path / "old.json"), str(tmp_path / "new.json"), *args],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_flat_program_bytes_pass(self, tmp_path):
+        p = self._run(tmp_path, self._payload(1e9), self._payload(1e9),
+                      "--gate", "bytes:_boot_batch")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "program bytes: ok" in p.stdout
+
+    def test_grown_program_bytes_fail(self, tmp_path):
+        p = self._run(tmp_path, self._payload(1e9), self._payload(1.5e9),
+                      "--gate", "bytes:_boot_batch")
+        assert p.returncode == 3
+        assert "program_profile._boot_batch.est_bytes" in p.stderr
+
+    def test_growth_within_factor_passes(self, tmp_path):
+        p = self._run(tmp_path, self._payload(1e9), self._payload(1.04e9),
+                      "--gate", "bytes:_boot_batch:1.05")
+        assert p.returncode == 0, p.stdout + p.stderr
+
+    def test_unknown_program_is_usage_error(self, tmp_path):
+        p = self._run(tmp_path, self._payload(1e9), self._payload(1e9),
+                      "--gate", "bytes:_no_such_program")
+        assert p.returncode == 1
+
+    def test_numeric_bytes_gate_still_aggregates(self, tmp_path):
+        # the pre-v9 spelling (a numeric factor) keeps gating the global
+        # estimated_bytes_accessed counter, not a program row
+        old = dict(self._payload(1e9), est_bytes=100.0)
+        new = dict(self._payload(1e9), est_bytes=100.0)
+        p = self._run(tmp_path, old, new, "--gate", "bytes:1.0")
+        assert p.returncode == 0, p.stdout + p.stderr
+
+
+# -----------------------------------------------------------------------------
+# perf_history: silent-shift annotation
+# -----------------------------------------------------------------------------
+
+
+class TestSilentShift:
+    def _payload(self, boot, assign, schema=9):
+        total = boot + assign
+        return {
+            "obs_schema": schema, "value": 1.0, "wall_s": 1.0,
+            "est_bytes": total,
+            "work_ledger": {"counters": {
+                "estimated_bytes_accessed": total,
+            }},
+            "program_profile": {
+                "programs": [
+                    {"name": "_boot_batch", "est_bytes": boot},
+                    {"name": "_assign_batch", "est_bytes": assign},
+                ],
+                "n_programs": 2,
+                "totals": {"est_bytes": total},
+            },
+        }
+
+    def test_shift_with_flat_aggregate_is_flagged(self):
+        ph = _load_tool("perf_history")
+        prev = self._payload(boot=1e9, assign=1e9)
+        cur = self._payload(boot=1.5e9, assign=0.5e9)  # flat total
+        note = ph._silent_shift_note(prev, cur)
+        assert note is not None and "SILENT SHIFT" in note
+        assert "_boot_batch" in note
+
+    def test_moved_aggregate_is_not_silent(self):
+        ph = _load_tool("perf_history")
+        prev = self._payload(boot=1e9, assign=1e9)
+        cur = self._payload(boot=2e9, assign=1e9)  # aggregate moved too
+        assert ph._silent_shift_note(prev, cur) is None
+
+    def test_missing_block_is_none(self):
+        ph = _load_tool("perf_history")
+        prev = self._payload(boot=1e9, assign=1e9)
+        assert ph._silent_shift_note(prev, {"obs_schema": 8}) is None
+        assert ph.program_bytes_of({"obs_schema": 8}) is None
+        assert ph.program_bytes_of(prev) == {
+            "_boot_batch": 1e9, "_assign_batch": 1e9,
+        }

@@ -42,6 +42,15 @@ Usage:
         # payload without the block is a loud failure (exit 1), except the
         # committed-pair modes, which warn-and-skip when only the OLD side
         # predates schema v7 (same precedent as the adjacent-bump fence).
+    python tools/bench_diff.py OLD NEW --gate bytes:_boot_batch  # per-program
+        # bytes gate (obs schema v9, ISSUE 16): one PROGRAM's ``est_bytes``
+        # row in the ``program_profile`` block — an O7 regression then
+        # names the offending jitted program, not just the aggregate.
+        # ``bytes:<program>:1.05`` allows 5% growth; a payload or program
+        # row missing on either side is a loud failure (exit 1). Plain
+        # ``bytes:<number>`` still gates the AGGREGATE est_bytes rung via
+        # the alias table — the spec is a program gate exactly when the
+        # first token after ``bytes:`` does not parse as a number.
 
 Noise-aware wall gates (ISSUE 12): the wall-derived rungs (value /
 vs_baseline / boots_per_sec / wall_s) are exactly the ones host
@@ -340,6 +349,59 @@ def split_work_gate(specs: List[str]) -> Tuple[Optional[float], List[str]]:
     return factor, rest
 
 
+def split_program_bytes_gates(
+    specs: List[str],
+) -> Tuple[List[Tuple[str, float]], List[str]]:
+    """Pull per-program byte gates out of the --gate list (ISSUE 16):
+    ``bytes:<program>`` gates that program's ``est_bytes`` row in the
+    ``program_profile`` block exactly; ``bytes:<program>:1.05`` allows 5%
+    growth. ``bytes:<number>`` is NOT a program gate — it stays in the list
+    and resolves through RUNG_ALIASES to the aggregate est_bytes rung.
+    Returns ([(program, growth-factor), ...], remaining specs)."""
+    gates: List[Tuple[str, float]] = []
+    rest: List[str] = []
+    for spec in specs:
+        rung, sep, tail = spec.partition(":")
+        if rung != "bytes" or not sep or not tail:
+            rest.append(spec)
+            continue
+        program, sep2, thresh = tail.partition(":")
+        try:
+            float(program)
+        except ValueError:
+            pass  # non-numeric: a program name — handled below
+        else:
+            rest.append(spec)  # numeric: the aggregate est_bytes gate
+            continue
+        factor = 1.0
+        if sep2:
+            try:
+                factor = float(thresh)
+            except ValueError:
+                raise BenchDiffError(
+                    1, f"--gate bytes:<program> threshold not a number: "
+                       f"{spec!r}"
+                )
+        gates.append((program, factor))
+    return gates, rest
+
+
+def program_bytes(payload: dict, program: str) -> Optional[float]:
+    """One program's ``est_bytes`` from the payload's ``program_profile``
+    block; None when the payload predates the block (schema < 9) or the
+    program has no row."""
+    pp = payload.get("program_profile")
+    if not isinstance(pp, dict):
+        return None
+    for row in pp.get("programs") or []:
+        if isinstance(row, dict) and row.get("name") == program:
+            try:
+                return float(row.get("est_bytes", 0))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
 def work_counters(payload: dict) -> Optional[dict]:
     """The payload's ``work_ledger.counters`` dict, or None when the payload
     predates the block (schema < 7)."""
@@ -456,6 +518,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(diff_table(old, new))
     parity_gated, numeric_gates = split_parity_gate(args.gate)
     work_factor, numeric_gates = split_work_gate(numeric_gates)
+    program_gates, numeric_gates = split_program_bytes_gates(numeric_gates)
     line = parity_line(old, new, same_schema=(s_old == s_new))
     if line is not None:
         print(line)
@@ -495,6 +558,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"work ledger: ok ({len(set(lo) | set(ln))} counters, "
                     f"gate factor {work_factor:g})"
                 )
+    for program, growth in program_gates:
+        ov, nv = program_bytes(old, program), program_bytes(new, program)
+        if ov is None or nv is None:
+            raise BenchDiffError(
+                1, f"--gate bytes:{program}: "
+                   f"{'old' if ov is None else 'new'} payload has no "
+                   f"program_profile row for {program!r} (schema >= 9 "
+                   "payloads name their programs; check the spelling "
+                   "against obs.schema.PROGRAM_NAMES)"
+            )
+        if nv > ov * growth:
+            failures.append(
+                f"program_profile.{program}.est_bytes: {ov:.3g} -> {nv:.3g} "
+                f"(per-program bytes grew; gate factor {growth:g})"
+            )
+        else:
+            print(
+                f"program bytes: ok ({program}: {ov:.3g} -> {nv:.3g}, "
+                f"gate factor {growth:g})"
+            )
     if parity_gated:
         if s_old != s_new:
             raise BenchDiffError(

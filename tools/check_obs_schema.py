@@ -34,6 +34,7 @@ from tools.graftlint.rules.schema_registry import (  # noqa: E402,F401
     FLIGHT_RE,
     MAYBE_SPAN_RE,
     METRIC_RE,
+    PROG_RE,
     SCAN,
     SITE_RE,
     SITE_SPEC_RE,
@@ -49,6 +50,7 @@ from tools.graftlint.rules.schema_registry import (  # noqa: E402,F401
     check_flight_alerts,
     check_help_registry,
     check_numeric_registry,
+    check_program_registry,
     check_resource_attrs,
     check_snn_impls,
     check_work_ledger,

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Flamegraph export for sampling-profiler blocks (obs/profiler.py).
+
+Usage:
+    python tools/flamegraph.py RECORD.jsonl                 # collapsed text
+    python tools/flamegraph.py RECORD.jsonl --speedscope OUT.json
+    python tools/flamegraph.py postmortem.json              # dump profiles too
+    python tools/flamegraph.py RECORD.jsonl --index 0 --top 40
+
+Input is anything that carries a ``profile`` block: a RunRecord JSONL file
+(``--index`` picks the record, default the last), or a flight-recorder
+``postmortem.json`` (the optional ``profile`` key an armed profiler rides
+into a dump). Two output formats:
+
+  * collapsed-stack text (default, stdout or ``--out``): one
+    ``frame;frame;frame weight`` line per folded stack — the input format
+    of every FlameGraph-family tool;
+  * speedscope JSON (``--speedscope PATH``): a "sampled"-type profile
+    loadable at https://www.speedscope.app (file-format-schema.json).
+
+Span-tag frames (``span:<name>``) fold like ordinary frames, so the
+flamegraph roots at the tracer's phase tree and descends into host stacks.
+
+Exit codes: 0 written/printed; 1 unreadable input or no profile block
+(arming instructions land on stderr).
+
+Standalone: stdlib-only, no package import — records and dumps are plain
+JSON and must stay readable on a host where the package is broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+SPEEDSCOPE_SCHEMA = "https://www.speedscope.app/file-format-schema.json"
+
+
+def load_profile(path: str, index: int = -1) -> Tuple[dict, str]:
+    """The ``profile`` block carried by ``path``: a RunRecord JSONL line
+    (``index`` selects among records that HAVE a profile) or a flight dump.
+    Returns (profile, source-description); raises ValueError otherwise."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise ValueError(f"{path}: unreadable: {e}")
+    objs: List[dict] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            objs.append(obj)
+    if not objs:
+        try:  # pretty-printed (multi-line) single JSON object
+            obj = json.loads(text)
+            if isinstance(obj, dict):
+                objs = [obj]
+        except json.JSONDecodeError:
+            pass
+    if not objs:
+        raise ValueError(f"{path}: no JSON objects found")
+    if "flight_dump_version" in objs[0]:
+        prof = objs[0].get("profile")
+        if not isinstance(prof, dict) or not prof.get("stacks"):
+            raise ValueError(
+                f"{path}: post-mortem carries no profile (the profiler was "
+                "not armed when the dump was written — set CCTPU_PROFILE_HZ)"
+            )
+        return prof, f"postmortem reason={objs[0].get('reason')}"
+    with_profile = [
+        (i, o) for i, o in enumerate(objs)
+        if isinstance(o.get("profile"), dict) and o["profile"].get("stacks")
+    ]
+    if not with_profile:
+        raise ValueError(
+            f"{path}: no record carries a profile block (arm the sampler "
+            "with CCTPU_PROFILE_HZ / ClusterConfig.profile_hz)"
+        )
+    try:
+        i, rec = with_profile[index]
+    except IndexError:
+        raise ValueError(
+            f"{path}: --index {index} out of range "
+            f"({len(with_profile)} record(s) carry a profile)"
+        )
+    return rec["profile"], f"record {i} (schema v{rec.get('schema', '?')})"
+
+
+def collapsed(profile: dict) -> str:
+    """FlameGraph collapsed-stack text: ``f;f;f weight`` per folded stack,
+    heaviest first."""
+    lines = []
+    for entry in profile.get("stacks", []):
+        frames = entry.get("frames") or ["<empty>"]
+        lines.append(f"{';'.join(frames)} {int(entry.get('weight', 0))}")
+    return "\n".join(lines)
+
+
+def speedscope(profile: dict, name: str = "consensusclustr-tpu") -> dict:
+    """A speedscope "sampled" profile: shared frame table + one weighted
+    sample (frame-index list) per folded stack."""
+    frame_ix = {}
+    frames: List[dict] = []
+    samples: List[List[int]] = []
+    weights: List[int] = []
+    for entry in profile.get("stacks", []):
+        sample = []
+        for fr in entry.get("frames") or ["<empty>"]:
+            if fr not in frame_ix:
+                frame_ix[fr] = len(frames)
+                frames.append({"name": fr})
+            sample.append(frame_ix[fr])
+        samples.append(sample)
+        weights.append(int(entry.get("weight", 0)))
+    total = sum(weights)
+    return {
+        "$schema": SPEEDSCOPE_SCHEMA,
+        "name": name,
+        "exporter": "consensusclustr-tpu tools/flamegraph.py",
+        "activeProfileIndex": 0,
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": "none",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", help="RunRecord JSONL or postmortem.json")
+    ap.add_argument("--index", type=int, default=-1,
+                    help="which profile-carrying record (default: last)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="keep only the N heaviest stacks")
+    ap.add_argument("--out", default=None,
+                    help="write collapsed text here instead of stdout")
+    ap.add_argument("--speedscope", default=None, metavar="PATH",
+                    help="also write a speedscope JSON profile to PATH")
+    args = ap.parse_args(argv)
+
+    try:
+        profile, source = load_profile(args.input, args.index)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.top is not None:
+        stacks = sorted(
+            profile.get("stacks", []),
+            key=lambda s: -int(s.get("weight", 0)),
+        )[:args.top]
+        profile = {**profile, "stacks": stacks}
+    print(
+        f"flamegraph: {source}: hz={profile.get('hz')} "
+        f"samples={profile.get('samples')} "
+        f"stacks={len(profile.get('stacks', []))} "
+        f"dropped={profile.get('dropped', 0)}",
+        file=sys.stderr,
+    )
+    text = collapsed(profile)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    if args.speedscope:
+        with open(args.speedscope, "w") as f:
+            json.dump(speedscope(profile), f)
+        print(f"flamegraph: speedscope profile -> {args.speedscope}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

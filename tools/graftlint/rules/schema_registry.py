@@ -28,6 +28,12 @@ Sub-rules (each a ``check_*`` function, all both-directions unless noted):
 * flight-alerts  — obs/alerts.py ``*_ALERT`` <-> ALERT_RULES and
                    obs/flight.py ``*_FLIGHT`` <-> FLIGHT_EVENT_KINDS;
                    cross-module consumers registered-only
+* program-registry — utils/compile_cache.py ``*_PROG`` field constants <->
+                   PROGRAM_PROFILE_FIELDS, plus every ``@counting_jit``-
+                   decorated def in the scanned trees <-> PROGRAM_NAMES
+                   (both ways: an unregistered program is an attribution
+                   row report tables cannot name; a registered program with
+                   no decorated def is a row nothing can ever fill)
 
 Why this is a lint rule: a typo'd metric name is a silently absent time
 series, a renamed fault site is a chaos audit that silently stops covering
@@ -88,6 +94,17 @@ SITE_SPEC_RE = re.compile(r"""["']([a-z][a-z0-9_]*):(?:raise|flaky|corrupt)""")
 CKPT_CALL_RE = re.compile(
     r"""numeric_checkpoint\(\s*[A-Za-z_][A-Za-z0-9_.]*\s*,\s*["']([A-Za-z0-9_]+)["']"""
 )
+# utils/compile_cache.py program-profile field constants: NAME_PROG = "literal"
+PROG_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_PROG)\s*=\s*["']([A-Za-z0-9_]+)["']""")
+# a counting_jit entry-point decorator: bare-call form (@counting_jit(...))
+# or the functools.partial form (@functools.partial(counting_jit, ...))
+COUNTING_JIT_DECO_RE = re.compile(
+    r"""^\s*@(?:functools\.partial\(\s*)?counting_jit\b"""
+)
+DEF_RE = re.compile(r"""^\s*def\s+([A-Za-z_][A-Za-z0-9_]*)\s*\(""")
+# a multiline decorator call can push the def several lines down; the widest
+# real site (parallel/step.py) sits 5 lines below its decorator
+_DECO_DEF_WINDOW = 15
 
 # Scanned trees/files, relative to the repo root. Tests are exempt (they
 # exercise the machinery with throwaway names on purpose). The package walk
@@ -421,6 +438,58 @@ def check_flight_alerts(root: str) -> List[str]:
     return errors
 
 
+def check_program_registry(root: str) -> List[str]:
+    """ISSUE 16: the per-program attribution registry, both directions.
+
+    * utils/compile_cache.py ``*_PROG`` field constants <->
+      schema.PROGRAM_PROFILE_FIELDS (complete: the registry is the contract
+      for ``program_profile`` consumers — bench_diff gates and report
+      tables read these keys, so an unbacked entry is a column nothing
+      fills);
+    * every ``@counting_jit``-decorated def in the scanned trees must be in
+      schema.PROGRAM_NAMES (an unregistered entry point attributes cost
+      under a name no gate or table knows), and every PROGRAM_NAMES entry
+      must be backed by a decorated def somewhere (a registered program
+      with no entry point is a row nothing can ever fill). Synthetic roots
+      with no decorated defs at all skip the completeness direction.
+    """
+    errors = _check_constant_registry(
+        root,
+        os.path.join("consensusclustr_tpu", "utils", "compile_cache.py"),
+        PROG_RE, "PROGRAM_PROFILE_FIELDS", "program field",
+        require_complete=True,
+    )
+    registry = getattr(schema, "PROGRAM_NAMES", None)
+    if registry is None:
+        return errors + ["obs/schema.py: PROGRAM_NAMES registry is missing"]
+    found: dict = {}
+    for path in _py_files(root):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            if not COUNTING_JIT_DECO_RE.match(line):
+                continue
+            for j in range(i + 1, min(i + 1 + _DECO_DEF_WINDOW, len(lines))):
+                m = DEF_RE.match(lines[j])
+                if m:
+                    found.setdefault(m.group(1), (rel, j + 1))
+                    break
+    for name, (rel, lineno) in sorted(found.items()):
+        if name not in registry:
+            errors.append(
+                f"{rel}:{lineno}: counting_jit program {name!r} not in "
+                "obs.schema.PROGRAM_NAMES"
+            )
+    if found:
+        for name in sorted(set(registry) - set(found)):
+            errors.append(
+                f"obs/schema.py: PROGRAM_NAMES entry {name!r} has no "
+                "counting_jit-decorated def in the scanned trees"
+            )
+    return errors
+
+
 def check(root: str) -> List[str]:
     """All schema violations under ``root`` as "file:line: message" strings."""
     errors: List[str] = (
@@ -432,6 +501,7 @@ def check(root: str) -> List[str]:
         + check_work_ledger(root)
         + check_snn_impls(root)
         + check_flight_alerts(root)
+        + check_program_registry(root)
     )
     for path in _py_files(root):
         rel = os.path.relpath(path, root)

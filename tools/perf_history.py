@@ -27,7 +27,11 @@ a wall that moved >= 1.5x while the ledger stayed identical is annotated
 "=> host noise" (the deterministic work did not change, so the time did
 not get slower for a code reason); a changed ledger names the counter that
 moved (the workload or its instrumentation changed); a schema bump is
-named as the comparability fence it is.
+named as the comparability fence it is. Schema v9 payloads additionally
+carry per-program attribution (``program_profile``): when the aggregate
+bytes stayed flat (within 2%) but an individual program's bytes grew
+>5%, the row is annotated as a SILENT SHIFT — work migrated between
+programs without moving the global counter (ISSUE 16).
 
 --check is the gate: exit 3 when any ADJACENT same-schema pair's ledger
 regressed (a counter grew), naming the pair and the counter. Cross-schema
@@ -62,6 +66,15 @@ FLAT_LEDGER_KEYS = {
 
 # wall ratio between adjacent rounds that earns a divergence annotation
 WALL_DIVERGENCE_RATIO = 1.5
+
+# Silent-shift detection (ISSUE 16): between adjacent rounds that both
+# carry a ``program_profile`` block, flag any single program whose
+# est_bytes grew by more than PROGRAM_SHIFT_RATIO while the AGGREGATE
+# bytes stayed within AGGREGATE_FLAT_RATIO — the failure mode a run-wide
+# counter can't see (one program regresses, another shrinks, the total
+# nets out flat).
+PROGRAM_SHIFT_RATIO = 1.05
+AGGREGATE_FLAT_RATIO = 1.02
 
 _JSON_LINE = re.compile(r"^\{.*\}$")
 _ROUND = re.compile(r"BENCH_r?0*(\d+)\.json$")
@@ -119,6 +132,47 @@ def ledger_of(payload: dict) -> Optional[dict]:
         if key in payload
     }
     return flat or None
+
+
+def program_bytes_of(payload: dict) -> Optional[dict]:
+    """{program: est_bytes} from the payload's ``program_profile`` block
+    (schema v9+), or None when the round predates it."""
+    pp = payload.get("program_profile")
+    if not isinstance(pp, dict):
+        return None
+    out = {}
+    for row in pp.get("programs") or []:
+        if isinstance(row, dict) and row.get("name") is not None:
+            try:
+                out[str(row["name"])] = float(row.get("est_bytes", 0))
+            except (TypeError, ValueError):
+                continue
+    return out or None
+
+
+def _silent_shift_note(prev: dict, cur: dict) -> Optional[str]:
+    """The per-program silent shift between two adjacent payloads, if any:
+    aggregate bytes flat but a single program's bytes up. None when either
+    side predates program_profile or no shift is detectable."""
+    pb_prev, pb_cur = program_bytes_of(prev), program_bytes_of(cur)
+    if pb_prev is None or pb_cur is None:
+        return None
+    led_prev, led_cur = ledger_of(prev) or {}, ledger_of(cur) or {}
+    agg_prev = float(led_prev.get("estimated_bytes_accessed", 0) or 0)
+    agg_cur = float(led_cur.get("estimated_bytes_accessed", 0) or 0)
+    if agg_prev <= 0 or agg_cur > agg_prev * AGGREGATE_FLAT_RATIO:
+        return None  # aggregate moved (or is unusable): not a SILENT shift
+    shifted = []
+    for name in sorted(set(pb_prev) & set(pb_cur)):
+        a, b = pb_prev[name], pb_cur[name]
+        if a > 0 and b > a * PROGRAM_SHIFT_RATIO:
+            shifted.append(f"{name} bytes x{b / a:.2f}")
+    if not shifted:
+        return None
+    return (
+        "SILENT SHIFT (aggregate bytes flat): " + ", ".join(shifted[:3])
+        + (", ..." if len(shifted) > 3 else "")
+    )
 
 
 def trial_cv(payload: dict) -> Optional[float]:
@@ -207,6 +261,10 @@ def annotate(rows: List[dict]) -> None:
                 notes.append(
                     "ledger changed: " + _ledger_delta_note(led_prev, led_cur)
                 )
+            if s_prev == s_cur:
+                shift = _silent_shift_note(prev, p)
+                if shift:
+                    notes.append(shift)
             if notes:
                 row["note"] = "; ".join(notes)
         prev = p
@@ -299,6 +357,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "wall_s": (r["payload"] or {}).get("wall_s"),
                 "cv": trial_cv(r["payload"]) if r["payload"] else None,
                 "ledger": ledger_of(r["payload"]) if r["payload"] else None,
+                "program_bytes": (
+                    program_bytes_of(r["payload"]) if r["payload"] else None
+                ),
             }
             for r in rows
         ]

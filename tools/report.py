@@ -35,7 +35,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -316,6 +316,95 @@ def work(record: dict) -> str:
     return "\n".join(lines)
 
 
+def programs(record: dict) -> str:
+    """Per-program cost-attribution table (obs schema >= 9): the
+    ``program_profile`` block utils/compile_cache.py stamps into the
+    RunRecord — one row per counting_jit entry point, ranked by est_bytes
+    (the O7 axis), plus the totals row that sums to the global
+    estimated_* counters by construction. Records written before schema v9
+    render the placeholder line — absence is normal, never an error (same
+    contract as the work table)."""
+    pp = record.get("program_profile") or {}
+    rows = pp.get("programs") or []
+    if not rows:
+        return "(no program attribution; schema < 9 record)"
+    cols = (
+        ("disp", "dispatches"),
+        ("comp", "compiles"),
+        ("gflops", "est_flops"),
+        ("acc_mb", "est_bytes"),
+        ("don_mb", "donated_bytes"),
+        ("wall_s", "dispatch_wall_s"),
+    )
+
+    def fmt(vals: dict, key: str) -> str:
+        v = vals.get(key)
+        if v is None:
+            return "-"
+        if key == "est_flops":
+            return f"{v / 1e9:.2f}"
+        if key in ("est_bytes", "donated_bytes"):
+            return f"{v / 1e6:.1f}"
+        if key == "dispatch_wall_s":
+            return f"{v:.3f}"
+        return f"{v:g}"
+
+    width = max(14, max(len(str(r.get("name", "?"))) for r in rows) + 1)
+    header = f"{'program':<{width}}" + "".join(
+        f"{label:>8}" for label, _ in cols
+    )
+    lines = [header]
+    for row in rows:
+        lines.append(
+            f"{str(row.get('name', '?')):<{width}}"
+            + "".join(f"{fmt(row, key):>8}" for _, key in cols)
+        )
+    totals = pp.get("totals") or {}
+    if totals:
+        lines.append(
+            f"{'(total)':<{width}}"
+            + "".join(f"{fmt(totals, key):>8}" for _, key in cols)
+        )
+    n = pp.get("n_programs")
+    if n is not None and n > len(rows):
+        lines.append(f"({n - len(rows)} more program(s) below the top-"
+                     f"{len(rows)} cut; totals cover all {n})")
+    return "\n".join(lines)
+
+
+def profile(record: dict) -> str:
+    """Top-N hot-stack table (obs schema >= 9): the sampling profiler's
+    folded stacks (``profile`` block), heaviest first — each line shows the
+    sample weight, the span-tag prefix (the phase the thread was in) and
+    the leaf-most host frames. Absent whenever CCTPU_PROFILE_HZ /
+    profile_hz was off (the default) — profiling is opt-in, the program
+    table above is always-on."""
+    pr = record.get("profile") or {}
+    stacks = pr.get("stacks") or []
+    if not stacks:
+        return "(no profile; arm with CCTPU_PROFILE_HZ / profile_hz)"
+    lines = [
+        f"hz={pr.get('hz')} samples={pr.get('samples')} "
+        f"unique_stacks={pr.get('unique_stacks')} "
+        f"dropped={pr.get('dropped', 0)}"
+    ]
+    total = sum(int(s.get("weight", 0)) for s in stacks) or 1
+    for entry in stacks[:10]:
+        frames = entry.get("frames") or []
+        spans = [f[len("span:"):] for f in frames if f.startswith("span:")]
+        host = [f for f in frames if not f.startswith("span:")]
+        leaf = " <- ".join(reversed(host[-3:])) if host else "<no host frames>"
+        w = int(entry.get("weight", 0))
+        lines.append(
+            f"{w:>6} ({100.0 * w / total:5.1f}%) "
+            f"[{'/'.join(spans) or '-'}] {leaf}"
+        )
+    if len(stacks) > 10:
+        lines.append(f"({len(stacks) - 10} more stack(s); "
+                     "tools/flamegraph.py renders them all)")
+    return "\n".join(lines)
+
+
 def consensus(record: dict) -> str:
     """Consensus-regime provenance table (ISSUE 9): which accumulator regime
     assembled each consensus (the ``cocluster`` span's ``consensus_regime``
@@ -542,6 +631,8 @@ def render(record: dict) -> str:
         "", "== consensus ==", consensus(record),
         "", "== dispatch ==", dispatch(record),
         "", "== work ==", work(record),
+        "", "== programs ==", programs(record),
+        "", "== profile ==", profile(record),
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),
         "", "== alerts ==", alerts(record),

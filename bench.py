@@ -485,6 +485,18 @@ def _postmortem_path():
     except Exception:
         return None
 
+# The fleet-SLO rung's zero shape (ISSUE 18): the 2-replica ladder block
+# plus the top-level gate rungs tools/bench_diff.py reads
+# (--gate fleet_p99:... / fleet_rejection_rate / fleet_swap_compiles).
+# Emitted on every rung including failure.
+_FLEET_SLO_ZERO = {
+    "fleet_slo": {"steps": []},
+    "fleet_p99_ms": 0.0,
+    "fleet_rejection_rate": 0.0,
+    "fleet_routed": {},
+    "fleet_swap_compiles": 0,
+}
+
 # The warm-start rung's zero shape (ISSUE 13) — emitted verbatim on the
 # failure rung so BENCH_*.json lines stay key-comparable across rounds.
 _WARM_START_ZERO = {
@@ -796,6 +808,127 @@ def _serving_slo_rung() -> dict:
         return out
 
 
+def _fleet_swap_pin(lg, art, rate, duration, genes, mix) -> dict:
+    """Hot-swap-under-load pin (ISSUE 18b): one 2-replica fleet at a
+    sub-saturation rate, ``swap_reference`` fired mid-schedule. The numbers
+    that matter: ``failed`` must be 0 (the old generation drains — every
+    accepted request completes) and ``swap_compiles`` must be 0 (the
+    standby replicas warm from the AOT caches, never a fresh trace)."""
+    import threading
+
+    from consensusclustr_tpu.serve.fleet import build_fleet
+
+    offsets = lg.schedule_offsets(rate, seed=11, duration=duration)
+    run: dict = {}
+    with build_fleet(art, 2, max_batch=64, queue_depth=16) as fleet:
+        th = threading.Thread(
+            target=lambda: run.update(
+                lg.run_open_loop(fleet, offsets, mix, genes, seed=11)
+            )
+        )
+        th.start()
+        time.sleep(duration / 2.0)  # swap lands mid-schedule
+        art2, _ = lg.synthetic_artifact(
+            art.embedding.shape[0], len(art.mu), seed=0
+        )
+        report = fleet.swap_reference(art2)
+        th.join(timeout=120.0)
+        routed = fleet.routed_per_replica()
+    return {
+        "rate_rps": round(float(rate), 2),
+        "swap_compiles": int(report["swap_compiles"]),
+        "generation": int(report["generation"]),
+        "submitted": run.get("submitted"),
+        "completed": run.get("completed"),
+        "rejected": run.get("rejected"),
+        "failed": run.get("failed"),
+        "routed": routed,
+    }
+
+
+def _fleet_slo_rung(rates=None) -> dict:
+    """Fleet-SLO ladder (ISSUE 18): the serving_slo ladder re-run against a
+    2-replica FleetRouter at the SAME offered rates — the committed
+    evidence that two replicas behind health-keyed admission sustain a
+    higher goodput plateau than one replica at the same offered load, with
+    per-step alert state and the routed-per-replica split recorded. Also
+    runs the hot-swap-under-load pin (``fleet_slo.swap``) whose compile
+    count lands top-level as ``fleet_swap_compiles``. Never raises: any
+    failure returns the zero shape with an error note."""
+    try:
+        lg = _load_loadgen()
+
+        genes = int(os.environ.get("BENCH_SERVE_GENES", 256))
+        n_ref = int(os.environ.get("BENCH_SERVE_REF", 2048))
+        duration = float(os.environ.get("BENCH_SLO_DURATION", 1.5))
+        mix = lg.parse_sizes(
+            os.environ.get("BENCH_SLO_SIZES", "1:0.5,4:0.3,16:0.2")
+        )
+        art, _ = lg.synthetic_artifact(n_ref, genes, seed=0)
+
+        if not rates:
+            # standalone fallback (BENCH_SLO_RATES or a fresh capacity
+            # probe) — the payload path hands over serving_slo's rates so
+            # the one-vs-two-replica comparison is at identical offered load
+            rates_env = os.environ.get("BENCH_SLO_RATES", "").strip()
+            if rates_env:
+                rates = [float(r) for r in rates_env.split(",") if r.strip()]
+            else:
+                from consensusclustr_tpu.serve.service import (
+                    AssignmentService,
+                )
+
+                with AssignmentService(
+                    art, max_batch=64, queue_depth=16
+                ) as probe_svc:
+                    cap = lg.estimate_capacity(
+                        probe_svc, mix, genes, n_requests=24
+                    )
+                rates = [round(cap * f, 2) for f in (0.5, 1.0, 2.0)]
+        ladder = lg.slo_ladder(
+            art, rates, duration, genes, mix, seed=7,
+            queue_depth=16, max_batch=64, target="fleet", replicas=2,
+        )
+        ladder["replicas"] = 2
+        ladder["swap"] = _fleet_swap_pin(
+            lg, art, min(rates), duration, genes, mix
+        )
+        sat = max(
+            (s for s in ladder["steps"] if "error" not in s),
+            key=lambda s: s.get("offered_rps", 0.0),
+            default=None,
+        )
+        out = {"fleet_slo": ladder}
+        out["fleet_p99_ms"] = float(sat["p99_ms"] or 0.0) if sat else 0.0
+        out["fleet_rejection_rate"] = (
+            float(sat["rejection_rate"]) if sat else 0.0
+        )
+        out["fleet_routed"] = dict((sat or {}).get("routed") or {})
+        out["fleet_swap_compiles"] = int(
+            ladder["swap"].get("swap_compiles") or 0
+        )
+        return out
+    except Exception as e:
+        out = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _FLEET_SLO_ZERO.items()}
+        out["fleet_slo"]["error"] = str(e)[:200]
+        return out
+
+
+def _slo_rungs() -> dict:
+    """serving_slo + fleet_slo in one block, the fleet ladder at the same
+    offered rates as the single-replica ladder (extracted from its steps) —
+    the apples-to-apples goodput comparison ISSUE 18 gates on."""
+    out = _serving_slo_rung()
+    rates = [
+        s["target_rps"]
+        for s in out.get("serving_slo", {}).get("steps", [])
+        if s.get("target_rps")
+    ]
+    out.update(_fleet_slo_rung(rates))
+    return out
+
+
 def _resilience_counters(tracer=None) -> dict:
     """Per-rung resilience telemetry (resilience/, ISSUE 10): retry and
     quarantine counters from the rung's run-local registry — all zero on a
@@ -1016,7 +1149,7 @@ def _run_pbmc3k() -> dict:
             res.run_record.spans if res.run_record is not None else []
         ),
         "serving": _serving_rung(),
-        **_serving_slo_rung(),
+        **_slo_rungs(),
         "sparse_consensus": _sparse_consensus_rung(),
         "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
@@ -1087,7 +1220,7 @@ def _run_granular() -> dict:
         "overlap_ratio": _overlap_ratio(tracer.roots),
         **_resilience_counters(tracer),
         "serving": _serving_rung(),
-        **_serving_slo_rung(),
+        **_slo_rungs(),
         "sparse_consensus": _sparse_consensus_rung(),
         "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
@@ -1256,7 +1389,7 @@ def _run() -> dict:
         **_dispatch_delta(flat0, _dispatch_counters()),
         **_resilience_counters(tracer),
         "serving": _serving_rung(),
-        **_serving_slo_rung(),
+        **_slo_rungs(),
         "sparse_consensus": _sparse_consensus_rung(),
         "warm_start": _warm_start_rung(),
         "obs_schema": _OBS_SCHEMA,
@@ -1475,6 +1608,8 @@ def main() -> None:
             "serving": dict(_SERVING_ZERO),
             **{k: (dict(v) if isinstance(v, dict) else v)
                for k, v in _SERVING_SLO_ZERO.items()},
+            **{k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in _FLEET_SLO_ZERO.items()},
             # a failed rung is exactly when a flight dump exists — point at it
             "postmortem_path": _postmortem_path(),
             "sparse_consensus": dict(_SPARSE_CONSENSUS_ZERO),

@@ -54,6 +54,9 @@ _LAZY = {
     "load_reference": ("consensusclustr_tpu.serve.artifact", "load_reference"),
     "ReferenceArtifact": ("consensusclustr_tpu.serve.artifact", "ReferenceArtifact"),
     "AssignmentService": ("consensusclustr_tpu.serve.service", "AssignmentService"),
+    # fleet surface (ISSUE 18): N replicas behind a health-aware router
+    "build_fleet": ("consensusclustr_tpu.serve.fleet", "build_fleet"),
+    "FleetRouter": ("consensusclustr_tpu.serve.router", "FleetRouter"),
 }
 
 
@@ -70,8 +73,10 @@ __all__ = [
     "ClusterConfig",
     "DEFAULT_RES_RANGE",
     "CountMatrix",
+    "FleetRouter",
     "ReferenceArtifact",
     "assign_cells",
+    "build_fleet",
     "consensus_clust",
     "export_reference",
     "get_clust_assignments",

@@ -1135,3 +1135,21 @@ def assign_cells(reference, counts, *, mode: str = "robust", **kwargs):
     from consensusclustr_tpu.serve.assign import assign_cells as _assign
 
     return _assign(reference, counts, mode=mode, **kwargs)
+
+
+def build_fleet(reference, n_replicas=None, *, config=None, control=None,
+                **svc_kwargs):
+    """Serve a reference from N replicas behind a FleetRouter (serve/fleet).
+
+    Health-keyed least-loaded admission, failover re-routing, zero-downtime
+    ``swap_reference`` version swaps, and (opt-in via ``control=True`` /
+    ``ClusterConfig.fleet_control`` / ``CCTPU_FLEET_CONTROL``) alert-driven
+    adaptive batching. The router duck-types the single-service surface:
+    ``submit`` / ``assign`` / ``health`` / ``close``. See docs/perf.md
+    "Running a fleet".
+    """
+    from consensusclustr_tpu.serve.fleet import build_fleet as _build
+
+    return _build(
+        reference, n_replicas, config=config, control=control, **svc_kwargs
+    )

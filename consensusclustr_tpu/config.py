@@ -222,6 +222,17 @@ class ClusterConfig:
     # matters cold. The watchdog itself rides the flight-recorder kill
     # switch: CCTPU_NO_FLIGHT=1 disarms both.
     stall_floor_s: Optional[float] = None
+    # Fleet layer (serve/fleet.py + serve/router.py, ISSUE 18): replica
+    # count behind the FleetRouter. None resolves CCTPU_FLEET_REPLICAS
+    # (default 2); must be >= 1.
+    fleet_replicas: Optional[int] = None
+    # Alert-driven adaptive control (serve/control.py, ISSUE 18): True arms
+    # the ControlPolicy (alerts + queue-wait modulate batching and
+    # admission). None resolves CCTPU_FLEET_CONTROL; unset = OFF, and off
+    # is pinned bit-identical to a routerless service (tests/test_fleet.py)
+    # — see docs/quirks.md "Observability schema v9 -> v10" for why a
+    # reproducible benchmark keeps this opt-in.
+    fleet_control: Optional[bool] = None
 
     def __post_init__(self):
         if isinstance(self.pc_num, str) and self.pc_num not in ("find", "getDenoisedPCs"):
@@ -297,6 +308,10 @@ class ClusterConfig:
         if self.stall_floor_s is not None and float(self.stall_floor_s) <= 0:
             raise ValueError(
                 f"stall_floor_s must be > 0; got {self.stall_floor_s}"
+            )
+        if self.fleet_replicas is not None and int(self.fleet_replicas) < 1:
+            raise ValueError(
+                f"fleet_replicas must be >= 1; got {self.fleet_replicas}"
             )
         if self.resource_sample_ms is not None and int(self.resource_sample_ms) < 0:
             raise ValueError(

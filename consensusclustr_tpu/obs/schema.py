@@ -112,7 +112,23 @@ from __future__ import annotations
 # failure payload — now carries ``program_profile``; armed profiles ride
 # flight-recorder dumps as an optional ``profile`` key. See docs/quirks.md
 # "Observability schema v8 → v9".
-SCHEMA_VERSION = 9
+# v10 (ISSUE 18): the fleet layer — serve/router.py's FleetRouter puts N
+# AssignmentService replicas behind health-keyed least-loaded admission,
+# serve/fleet.py builds them, serve/control.py is the opt-in alert-driven
+# ControlPolicy (CCTPU_FLEET_CONTROL, off is pinned free). New names: the
+# fleet_* counters/gauges below (routing, rejection, failover, swap and
+# control accounting — per-replica *gauges* carry the routed-to replica's
+# state; the full per-replica split lives in FleetRouter.health()["routed"]
+# because registry instruments are label-less by design), the fleet_*
+# events, the ``fleet_swap`` span, and the CCTPU_FLEET_* knobs. Every bench
+# payload — including the failure rung — now carries the ``fleet_slo``
+# block plus top-level ``fleet_p99_ms`` / ``fleet_rejection_rate`` /
+# ``fleet_routed`` / ``fleet_swap_compiles`` keys (zero-shape
+# ``_FLEET_SLO_ZERO`` on failure). The RunRecord *layout* is unchanged —
+# the bump marks the payload keys and the name vocabulary so bench_diff
+# treats v9/v10 artifacts as schema-incomparable. See docs/quirks.md
+# "Observability schema v9 → v10".
+SCHEMA_VERSION = 10
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -175,6 +191,18 @@ EVENT_KINDS = frozenset({
     "alert_raised",          # an ALERT_RULES rule transitioned to firing
                              # (name, value, threshold attrs)
     "alert_cleared",         # a previously firing rule recovered
+    # serve/router.py fleet layer (ISSUE 18)
+    "fleet_start",           # router up (replicas list + control-armed attrs)
+    "fleet_drain",           # router closed; routed-per-replica split attr
+    "fleet_replica_down",    # a health scrape took a replica out of rotation
+                             # (replica + status attrs)
+    "fleet_replica_revived", # a dead slot was respawned from the template
+    "fleet_failover",        # a replica died holding accepted requests; they
+                             # re-queued as orphans (replica + error attrs)
+    "fleet_swap",            # zero-downtime version swap completed
+                             # (generation, swap_compiles, wall_s attrs)
+    "fleet_control",         # a ControlPolicy pressure-class transition on
+                             # one replica (replica, reason, deadline attrs)
 })
 
 # Hierarchical span names (``Tracer.span`` / ``maybe_span``).
@@ -205,6 +233,10 @@ SPAN_NAMES = frozenset({
     "serve_warmup",     # bucket-ladder compile pass at service load
     "serve_batch",      # one micro-batch: request_ids list, bucket, rows,
                         # queue-age-at-dispatch attrs (the flow-event target)
+    # serve/router.py (ISSUE 18)
+    "fleet_swap",       # the whole hot-swap window: standby build -> atomic
+                        # flip -> old-generation drain (swap_compiles attr is
+                        # the pinned zero)
 })
 
 # Metric name -> one-line help text. This IS the metric registry: the name
@@ -276,6 +308,21 @@ METRIC_HELP = {
     "postmortem_dumps": "counter: flight-recorder post-mortem dumps written (exception/signal/fail_all/retries_exhausted/stall)",
     "alerts_raised": "counter: SLO alert rule raise transitions (obs/alerts.py AlertEngine)",
     "alerts_active": "gauge: currently firing SLO alert rules (0 on a healthy replica — the /healthz drain signal)",
+    # fleet layer (serve/router.py, ISSUE 18) — registry instruments are
+    # label-less, so the per-replica gauges carry the *routed-to* replica's
+    # state at admission; the full per-replica split is in
+    # FleetRouter.health()["routed"] and the bench fleet_slo rung
+    "fleet_requests_routed": "counter: requests admitted and routed to a replica by the FleetRouter",
+    "fleet_rejections": "counter: fleet-wide rejections (every admitting replica rejected — true saturation)",
+    "fleet_failovers": "counter: accepted requests orphaned by a replica death and re-queued for re-routing",
+    "fleet_replica_unhealthy": "counter: admission passes that skipped a replica on a not-ok health scrape",
+    "fleet_replicas": "gauge: replicas currently in rotation",
+    "fleet_replica_queue_depth": "gauge: queue occupancy of the routed-to replica at admission",
+    "fleet_replica_inflight": "gauge: in-flight requests of the routed-to replica at admission",
+    "fleet_swaps": "counter: zero-downtime reference swaps completed (swap_reference)",
+    "fleet_swap_compiles": "counter: fresh executable compiles during swap windows (pinned 0 when the AOT cache is warm)",
+    "fleet_control_sheds": "counter: requests shed at the router door by an armed ControlPolicy under burn pressure",
+    "fleet_control_decisions": "counter: ControlPolicy pressure-class transitions applied to a replica",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -506,6 +553,18 @@ ENV_KNOBS = {
     "CCTPU_FAULT_INJECT": (
         "unset",
         "Fault-injection spec 'site:kind[:arg][,...]' planted at FAULT_SITES.",
+    ),
+    "CCTPU_FLEET_CONTROL": (
+        "unset",
+        "Truthy arms the fleet ControlPolicy (alert-driven adaptive batching/admission).",
+    ),
+    "CCTPU_FLEET_CONTROL_DEADLINE_MS": (
+        "2.0",
+        "Armed-control base batch-gather deadline in milliseconds.",
+    ),
+    "CCTPU_FLEET_REPLICAS": (
+        "2",
+        "Default FleetRouter replica count (build_fleet).",
     ),
     "CCTPU_FORCE_CPU": (
         "unset",

@@ -25,10 +25,20 @@ Three layers, lowest first:
     ``RetryableRejection``), graceful drain, and obs/ metrics
     (``serve_latency_seconds``, ``queue_depth``, ``batch_occupancy``,
     ``serve_compile``).
+  * ``fleet``     — the ISSUE 18 multi-replica layer: ``FleetRouter``
+    (``router``) puts N services behind health-keyed least-loaded
+    admission with failover and zero-downtime ``swap_reference``;
+    ``control`` is the opt-in alert-driven ``ControlPolicy``;
+    ``build_fleet`` (``fleet``) assembles it all.
 
 Top-level surface: ``api.export_reference(result, path)`` /
-``api.assign_cells(reference, counts)``; ``tools/serve_demo.py`` is the
-export-then-query driver.
+``api.assign_cells(reference, counts)`` / ``api.build_fleet(reference)``;
+``tools/serve_demo.py`` is the export-then-query driver and
+``tools/loadgen.py --target fleet`` drives a router.
+
+The fleet names below are lazy (PEP 562): importing this package stays
+jax-free; touching ``build_fleet`` / ``FleetRouter`` / ``ControlPolicy``
+pulls the serving stack.
 """
 
 from consensusclustr_tpu.serve.artifact import (
@@ -47,10 +57,31 @@ __all__ = [
     "ArtifactChecksumError",
     "ArtifactError",
     "ArtifactSchemaError",
+    "ControlPolicy",
+    "FleetRouter",
     "ReferenceArtifact",
     "ReferenceFit",
     "SERVE_SCHEMA_VERSION",
+    "build_fleet",
     "export_reference",
     "load_reference",
     "reference_from_result",
 ]
+
+_LAZY = {
+    "FleetRouter": ("consensusclustr_tpu.serve.router", "FleetRouter"),
+    "build_fleet": ("consensusclustr_tpu.serve.fleet", "build_fleet"),
+    "ControlPolicy": ("consensusclustr_tpu.serve.control", "ControlPolicy"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)

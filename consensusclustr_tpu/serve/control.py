@@ -1,0 +1,177 @@
+"""Alert-driven adaptive serving control (ISSUE 18 tentpole, part c).
+
+The PR 14 alert engine raises ``serve_p99_high`` / ``slo_burn_rate_high``
+but nothing *acts* on them — the queue is the only actuator, and it only
+acts by rejecting. This module closes the ROADMAP O3 loop: a
+:class:`ControlPolicy` reads a replica's health scrape (the same
+``/healthz`` body the router scores) plus its live ``queue_wait_seconds``
+histogram and decides, per replica, how the serving worker should batch and
+whether the router should still admit:
+
+  * **latency pressure** (``serve_p99_high`` firing, or queue-wait p99 past
+    ``QUEUE_WAIT_BOUND_S``): flush immediately — batch-gather deadline
+    drops to 0 and the micro-batch row cap halves, so the worker forms
+    smaller batches that land in smaller pad buckets and drain faster.
+    Throughput is deliberately sacrificed for the tail.
+  * **burn pressure** (``slo_burn_rate_high`` firing — rejections eating
+    the error budget): batch harder — the gather deadline stretches to
+    ``BURN_DEADLINE_FACTOR``x so each dispatch carries more rows — and,
+    once the queue passes ``SHED_OCCUPANCY``, the router sheds at the door
+    (a ``RetryableRejection`` with a drain-rate hint) instead of letting
+    the queue overflow reject with no warning.
+  * **calm**: the small base gather deadline
+    (``CCTPU_FLEET_CONTROL_DEADLINE_MS``) — a bounded wait that trades
+    microseconds of latency for fuller buckets.
+
+Strictly opt-in (``CCTPU_FLEET_CONTROL`` / ``ClusterConfig.fleet_control``,
+default OFF), PR 8/14/16 style: when off, :meth:`ControlPolicy.decide`
+returns the inert :data:`NO_CONTROL` decision, the router applies nothing,
+and the worker's batch path is bit-identical to a build without this module
+(pinned in tests/test_fleet.py — identical labels AND identical work
+ledger). Why off by default: adaptive batching changes *which requests
+share a micro-batch*, which changes nothing about any single result (the
+assign path is row-independent) but does change latency decomposition and
+bucket choice — exactly the class of behavior a reproducible benchmark
+must not have silently enabled. See docs/quirks.md "Observability schema
+v9 -> v10".
+
+Import-light: no jax — the router and the config validator import this
+module without touching a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from consensusclustr_tpu.obs.alerts import BURN_ALERT, P99_ALERT
+from consensusclustr_tpu.obs.metrics import MetricsRegistry
+
+# Armed-control tuning constants. Deliberately few and deliberately not all
+# env knobs: the two that matter operationally (arming, base deadline) are;
+# the shed/bound constants are policy shape, pinned by tests.
+DEFAULT_CONTROL_DEADLINE_MS = 2.0
+SHED_OCCUPANCY = 0.8          # queue fill fraction where burn pressure sheds
+QUEUE_WAIT_BOUND_S = 1.0      # queue-wait p99 treated as latency pressure
+BURN_DEADLINE_FACTOR = 4.0    # gather-deadline stretch under burn pressure
+_MIN_WAIT_COUNT = 20          # queue-wait observations before p99 is trusted
+
+
+def fleet_control_enabled(
+    requested: Optional[bool] = None, config=None
+) -> bool:
+    """Explicit arg > ``ClusterConfig.fleet_control`` > truthy
+    ``CCTPU_FLEET_CONTROL`` env > OFF (the default — off is pinned free)."""
+    if requested is not None:
+        return bool(requested)
+    cfg_val = getattr(config, "fleet_control", None)
+    if cfg_val is not None:
+        return bool(cfg_val)
+    env = os.environ.get("CCTPU_FLEET_CONTROL", "").strip().lower()
+    return env not in ("", "0", "off", "false", "none")
+
+
+def control_deadline_s(requested_ms: Optional[float] = None) -> float:
+    """Armed base gather deadline in seconds: explicit arg >
+    ``CCTPU_FLEET_CONTROL_DEADLINE_MS`` > 2 ms."""
+    if requested_ms is None:
+        env = os.environ.get("CCTPU_FLEET_CONTROL_DEADLINE_MS", "").strip()
+        requested_ms = float(env) if env else DEFAULT_CONTROL_DEADLINE_MS
+    ms = float(requested_ms)
+    if ms < 0:
+        raise ValueError(f"control deadline must be >= 0 ms; got {ms}")
+    return ms / 1000.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlDecision:
+    """What one replica's worker + the router door should do right now.
+
+    ``batch_deadline_s`` / ``batch_rows_cap`` map 1:1 onto the
+    AssignmentService attributes of the same names (worker-side batching);
+    ``admit`` gates the router door; ``reason`` is the pressure class
+    ("latency" / "burn" / "" when calm) — transitions are what the router
+    counts and events.
+    """
+
+    batch_deadline_s: float = 0.0
+    batch_rows_cap: Optional[int] = None
+    admit: bool = True
+    reason: str = ""
+
+
+# The disarmed decision: exactly the AssignmentService defaults, so applying
+# it is indistinguishable from never applying anything.
+NO_CONTROL = ControlDecision()
+
+
+class ControlPolicy:
+    """Per-replica adaptive decisions off the live alert + queue-wait state.
+
+    Stateless across calls (the router owns per-replica transition
+    memory): ``decide`` is a pure function of the scrape, so tests can pin
+    the policy table directly.
+    """
+
+    def __init__(
+        self,
+        enabled: Optional[bool] = None,
+        *,
+        config=None,
+        deadline_ms: Optional[float] = None,
+    ) -> None:
+        self.enabled = fleet_control_enabled(enabled, config)
+        self.deadline_s = control_deadline_s(deadline_ms)
+
+    def _queue_wait_p99(self, metrics: Optional[MetricsRegistry]):
+        if metrics is None:
+            return None
+        h = metrics.histograms.get("queue_wait_seconds")
+        if h is None or h.count < _MIN_WAIT_COUNT:
+            return None
+        try:
+            return h.quantile(0.99)
+        except Exception:  # graftlint: noqa[GL007] quantile on a malformed/empty histogram just means "no latency signal yet" — control degrades to the calm decision
+            return None
+
+    def decide(
+        self,
+        health: dict,
+        queue_capacity: int,
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> ControlDecision:
+        """One replica's decision from its health scrape.
+
+        ``health`` is the AssignmentService.health() dict (``queue_depth``
+        there is *occupancy*); ``queue_capacity`` is the service's
+        configured depth; ``metrics`` the replica's registry for the
+        queue-wait histogram. Disarmed -> :data:`NO_CONTROL`, always.
+        """
+        if not self.enabled:
+            return NO_CONTROL
+        active = set(health.get("alerts_active") or ())
+        wait_p99 = self._queue_wait_p99(metrics)
+        latency = P99_ALERT in active or (
+            wait_p99 is not None and wait_p99 > QUEUE_WAIT_BOUND_S
+        )
+        burn = BURN_ALERT in active
+        occupancy = (
+            float(health.get("queue_depth", 0)) / queue_capacity
+            if queue_capacity > 0
+            else 0.0
+        )
+        if latency:
+            # flush now, batch small: smaller pad buckets drain faster
+            cap = max(1, int(health.get("max_batch", 0) or 0) // 2) or None
+            return ControlDecision(0.0, cap, True, "latency")
+        if burn:
+            # batch harder for throughput; past SHED_OCCUPANCY shed at the
+            # door (with a hint) before the queue overflows (without one)
+            return ControlDecision(
+                self.deadline_s * BURN_DEADLINE_FACTOR,
+                None,
+                occupancy < SHED_OCCUPANCY,
+                "burn",
+            )
+        return ControlDecision(self.deadline_s, None, True, "calm")

@@ -1,0 +1,81 @@
+"""Fleet assembly: build N replicas behind a FleetRouter (ISSUE 18).
+
+The router (serve/router.py) is deliberately ignorant of how replicas are
+made — it takes ready services plus a *spawn template*. This module is the
+template factory: :func:`build_fleet` resolves the replica count
+(explicit arg > ``ClusterConfig.fleet_replicas`` > ``CCTPU_FLEET_REPLICAS``
+> 2), captures the AssignmentService construction kwargs once, and hands
+the router a ``spawn(reference)`` callable it reuses for failover revival
+and for :meth:`FleetRouter.swap_reference` standbys — so a revived or
+swapped-in replica is configured exactly like the originals.
+
+Quick start (also in README)::
+
+    from consensusclustr_tpu.serve import build_fleet
+
+    fleet = build_fleet(artifact, 2, queue_depth=16, max_batch=64)
+    try:
+        labels = fleet.assign(counts).labels
+        fleet.swap_reference(artifact_v2)     # zero-downtime version swap
+    finally:
+        fleet.close()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from consensusclustr_tpu.serve.control import ControlPolicy
+from consensusclustr_tpu.serve.router import FleetRouter
+from consensusclustr_tpu.serve.service import AssignmentService
+
+DEFAULT_FLEET_REPLICAS = 2
+
+
+def fleet_replicas(requested: Optional[int] = None, config=None) -> int:
+    """Replica count: explicit arg > ``ClusterConfig.fleet_replicas`` >
+    ``CCTPU_FLEET_REPLICAS`` env > 2. Must be >= 1."""
+    if requested is None:
+        cfg_val = getattr(config, "fleet_replicas", None)
+        if cfg_val is not None:
+            requested = int(cfg_val)
+        else:
+            env = os.environ.get("CCTPU_FLEET_REPLICAS", "").strip()
+            requested = int(env) if env else DEFAULT_FLEET_REPLICAS
+    n = int(requested)
+    if n < 1:
+        raise ValueError(f"fleet needs at least 1 replica; got {n}")
+    return n
+
+
+def build_fleet(
+    reference,
+    n_replicas: Optional[int] = None,
+    *,
+    config=None,
+    control: Optional[bool] = None,
+    **svc_kwargs,
+) -> FleetRouter:
+    """Build ``n_replicas`` AssignmentService replicas behind a FleetRouter.
+
+    ``svc_kwargs`` pass through to every AssignmentService (and to every
+    future revival/standby — the spawn template captures them), e.g.
+    ``queue_depth``, ``max_batch``, ``buckets``, ``mode``, ``warmup``.
+    ``control`` arms the adaptive ControlPolicy (resolution: arg >
+    ``config.fleet_control`` > ``CCTPU_FLEET_CONTROL`` > off; the off
+    state is pinned bit-identical to a routerless service).
+    """
+    n = fleet_replicas(n_replicas, config)
+    policy = ControlPolicy(control, config=config)
+
+    def spawn(ref, name: str = "") -> AssignmentService:
+        # replica_name at CONSTRUCTION: a permanently-faulted worker can
+        # _fail_all before the router gets a chance to stamp the name, and
+        # the post-mortem must still say which replica died
+        return AssignmentService(
+            ref, config=config, replica_name=name, **svc_kwargs
+        )
+
+    services = [spawn(reference, f"r{i}") for i in range(n)]
+    return FleetRouter(services, control=policy, spawn=spawn)

@@ -1,0 +1,692 @@
+"""Multi-replica admission router (ISSUE 18 tentpole, part a).
+
+A :class:`FleetRouter` owns N :class:`~consensusclustr_tpu.serve.service.
+AssignmentService` replicas and routes each submit by the same signals a
+real fleet's load balancer scrapes from ``/healthz`` — here read in-process
+from :meth:`AssignmentService.health`:
+
+  * ``status``            — anything but "ok" (draining / closed / a worker
+    past its restart budget) takes the replica out of rotation and counts
+    ``fleet_replica_unhealthy``;
+  * ``alerts_active``     — a replica firing ``serve_p99_high`` or
+    ``slo_burn_rate_high`` is *degraded*: still admitting, but only chosen
+    when every clean replica rejected;
+  * ``queue_depth`` / ``in_flight`` — least-loaded admission among equals;
+  * drain rate            — each replica's ``retry_after_hint()`` is the
+    backoff the fleet-wide rejection carries.
+
+The router raises :class:`RetryableRejection` only when EVERY replica
+rejected (fleet saturation); a single full replica just routes elsewhere.
+Each accepted request gets a *router future* chained onto the replica
+future, and the chain is also the self-healing path: when a replica dies
+mid-request (the supervisor's give-up ``_fail_all``), its accepted
+requests are not lost — they re-queue as orphans, a failover thread
+re-routes them to a healthy replica (reviving dead slots from the spawn
+template when none is left), and the original caller's future completes
+as if nothing happened. tools/chaos_audit.py's ``fleet_replica_death``
+preset pins exactly this: a ``serve_worker`` fault kills a replica
+mid-ladder, no accepted request is lost, and the post-mortem names the
+dead replica.
+
+Fleet-level observability rides the router's own tracer: the
+``fleet_*`` metrics registered in obs/schema.py (v10), a fleet
+``serve_latency_seconds`` histogram (observed per completed request
+*before* the router future resolves, so a client that saw a result is
+already counted), a fleet ``serve_rejections`` counter — which means the
+PR 14 alert rules evaluate unchanged one level up — and ``fleet_*``
+events for swaps, failovers and control transitions.
+
+Hot-swap (:meth:`swap_reference`, ISSUE 18 part b) lives here because the
+flip is an admission decision: standby replicas for the new artifact warm
+from the PR 13 AOT caches (in-process registry first, disk second — zero
+compiles when the version was ever served before), the replica list swaps
+under the lock in one assignment (atomic for every concurrent submit
+snapshot), and the old replicas drain via ``close()`` — every accepted
+request completes, so a loadgen run straddling the swap shows 0 failures
+and 0 swap-time ``executable_compiles``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+from consensusclustr_tpu.obs.alerts import BURN_ALERT, P99_ALERT, attach_alerts
+from consensusclustr_tpu.obs.flight import attach_flight
+from consensusclustr_tpu.obs.metrics import global_metrics
+from consensusclustr_tpu.obs.record import RunRecord
+from consensusclustr_tpu.obs.tracer import Tracer
+from consensusclustr_tpu.serve.control import NO_CONTROL, ControlPolicy
+from consensusclustr_tpu.serve.service import (
+    AssignmentService,
+    AssignResult,
+    RetryableRejection,
+)
+
+# Orphan failover pacing: capped linear backoff between re-route attempts
+# while no replica is healthy (a planted permanent fault keeps killing
+# revived replicas until the chaos harness clears it).
+_ORPHAN_BACKOFF_S = 0.05
+_ORPHAN_BACKOFF_MAX_S = 1.0
+_ORPHAN_ATTEMPT_LIMIT = 400
+_FAILOVER_POLL_S = 0.1
+# Idle-poll revival pacing: a planted permanent fault (chaos presets) kills
+# every revived replica instantly; retrying a full respawn+warmup on every
+# 100 ms poll would be churn, so revival attempts are rate-limited.
+_REVIVE_INTERVAL_S = 0.5
+_SENTINEL = object()
+
+# Degraded-routing alert set: a replica firing either is only chosen when
+# every clean replica rejected.
+_DEGRADED_ALERTS = frozenset({P99_ALERT, BURN_ALERT})
+
+# Admission-path scrape cadence: a full health() scrape evaluates every
+# alert rule (~100 us on a slow core), which at saturation rates would burn
+# a double-digit share of one core on scrapes alone. The router therefore
+# scrapes each replica at most every _HEALTH_TTL_S and routes on the cached
+# verdict plus a live (cheap) in-flight read. Staleness is safe, not just
+# tolerable: a replica that dies inside the TTL window fails its submit
+# with RuntimeError, which marks it unhealthy and drops the cache on the
+# spot — the stale "ok" never strands a request.
+_HEALTH_TTL_S = 0.05
+
+
+class _Replica:
+    """One owned service + the router's per-replica bookkeeping."""
+
+    __slots__ = ("name", "svc", "routed", "control_reason", "score",
+                 "score_at", "admit")
+
+    def __init__(self, name: str, svc: AssignmentService) -> None:
+        self.name = name
+        self.svc = svc
+        self.routed = 0
+        self.control_reason = ""
+        # cached (healthy, degraded, load, health) + scrape time + control
+        # admit verdict — refreshed by FleetRouter._scored on TTL expiry
+        self.score = None
+        self.score_at = -1e9
+        self.admit = True
+        svc.replica_name = name
+
+
+class _Orphan:
+    """An accepted request whose replica died before completing it."""
+
+    __slots__ = ("future", "counts", "mode", "attempts", "last_error", "t0")
+
+    def __init__(self, future, counts, mode, t0) -> None:
+        self.future = future
+        self.counts = counts
+        self.mode = mode
+        self.attempts = 0
+        self.last_error: Optional[BaseException] = None
+        self.t0 = t0
+
+
+class FleetRouter:
+    """Health-keyed admission over N AssignmentService replicas.
+
+    Duck-types the single-service surface tools/loadgen.py drives
+    (``submit`` / ``assign`` / ``max_batch`` / ``metrics`` / ``tracer`` /
+    ``health`` / ``retry_after_hint`` / ``close`` / context manager), so
+    ``--target fleet`` and the bench ``fleet_slo`` rung reuse the open-loop
+    machinery unchanged.
+    """
+
+    def __init__(
+        self,
+        services: Sequence[AssignmentService],
+        *,
+        control: Optional[ControlPolicy] = None,
+        spawn: Optional[Callable[[object], AssignmentService]] = None,
+        tracer: Optional[Tracer] = None,
+    ) -> None:
+        if not services:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.metrics = self.tracer.metrics
+        attach_flight(self.tracer)
+        self._alerts = attach_alerts(self.tracer)
+        self.control = control if control is not None else ControlPolicy()
+        self._spawn = spawn
+        # Does the spawn template accept a replica-name argument?
+        # (serve.fleet.build_fleet's does — naming at construction means a
+        # worker that dies inside the ctor still post-mortems by name.)
+        self._spawn_takes_name = False
+        if spawn is not None:
+            try:
+                self._spawn_takes_name = (
+                    len(inspect.signature(spawn).parameters) >= 2
+                )
+            except (TypeError, ValueError):  # builtins / odd callables
+                self._spawn_takes_name = False
+        self._lock = threading.RLock()
+        self._gen = 0
+        self._replicas: List[_Replica] = [
+            _Replica(f"r{i}", svc) for i, svc in enumerate(services)
+        ]
+        self.reference = services[0].reference
+        self._closing = False
+        self._closed = False
+        self._accepted = 0
+        self._completed = 0
+        self._orphans: "queue.Queue" = queue.Queue()
+        self._last_revive = 0.0
+        self._revivals = 0
+        self._failover = threading.Thread(
+            target=self._failover_loop, name="cctpu-fleet-failover",
+            daemon=True,
+        )
+        # admission hot path: resolve metric handles once (a registry lookup
+        # per routed request is measurable at saturation rates), and pace the
+        # fleet-level alert sweep like the health scrapes
+        self._c_routed = self.metrics.counter("fleet_requests_routed")
+        self._c_unhealthy = self.metrics.counter("fleet_replica_unhealthy")
+        self._c_fleet_rej = self.metrics.counter("fleet_rejections")
+        self._c_serve_rej = self.metrics.counter("serve_rejections")
+        self._h_latency = self.metrics.histogram("serve_latency_seconds")
+        self._g_queue_depth = self.metrics.gauge("fleet_replica_queue_depth")
+        self._g_inflight = self.metrics.gauge("fleet_replica_inflight")
+        self._last_alert_eval = -1e9
+        self._failover.start()
+        self.metrics.gauge("fleet_replicas").set(len(self._replicas))
+        self.tracer.event(
+            "fleet_start",
+            replicas=[r.name for r in self._replicas],
+            control=self.control.enabled,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop intake, drain every replica (all accepted requests
+        complete), stop the failover thread."""
+        if self._closed:
+            return
+        self._closing = True
+        self._orphans.put(_SENTINEL)
+        self._failover.join()
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            try:
+                rep.svc.close()
+            except Exception:  # graftlint: noqa[GL007] a replica that cannot drain must not block the fleet's shutdown of its siblings
+                pass
+        self._closed = True
+        self.tracer.event("fleet_drain", routed=self.routed_per_replica())
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- single-service duck type --------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        with self._lock:
+            return min(r.svc.max_batch for r in self._replicas)
+
+    @property
+    def replicas(self) -> List[AssignmentService]:
+        with self._lock:
+            return [r.svc for r in self._replicas]
+
+    @property
+    def generation(self) -> int:
+        return self._gen
+
+    def routed_per_replica(self) -> Dict[str, int]:
+        """{replica name: requests routed there} — the bench rung's split."""
+        with self._lock:
+            return {r.name: r.routed for r in self._replicas}
+
+    def retry_after_hint(self) -> Optional[float]:
+        """The most optimistic replica drain hint (a fleet retry should wait
+        for the FIRST slot anywhere, not the slowest)."""
+        hints = []
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            try:
+                h = rep.svc.retry_after_hint()
+            except Exception:  # graftlint: noqa[GL007] best-effort backoff hint; a hintless rejection is the documented degrade (hint stays None)
+                h = None
+            if h is not None:
+                hints.append(h)
+        return min(hints) if hints else None
+
+    # -- admission -----------------------------------------------------------
+
+    def _score(self, rep: _Replica):
+        """(healthy, degraded, load, health-dict) for one replica. Unhealthy
+        replicas return healthy=False and are skipped by routing."""
+        try:
+            h = rep.svc.health()
+        except Exception as e:  # graftlint: noqa[GL007] probe failure IS the signal — the caller records it via the fleet_replica_down event in _mark_unhealthy
+            return (False, True, 0, {"status": f"error:{type(e).__name__}"})
+        healthy = h.get("status") == "ok"
+        degraded = bool(_DEGRADED_ALERTS & set(h.get("alerts_active") or ()))
+        load = int(h.get("in_flight", 0))
+        return (healthy, degraded, load, h)
+
+    def _apply_control(self, rep: _Replica, health: dict) -> bool:
+        """Apply the ControlPolicy decision to one replica's worker knobs;
+        returns its admit verdict. Disarmed control touches nothing."""
+        if not self.control.enabled:
+            return True
+        dec = self.control.decide(
+            health, rep.svc.queue_depth, rep.svc.metrics
+        )
+        if dec is NO_CONTROL:
+            return True
+        rep.svc.batch_deadline_s = dec.batch_deadline_s
+        rep.svc.batch_rows_cap = dec.batch_rows_cap
+        if dec.reason != rep.control_reason:
+            rep.control_reason = dec.reason
+            self.metrics.counter("fleet_control_decisions").inc()
+            self.tracer.event(
+                "fleet_control",
+                replica=rep.name,
+                reason=dec.reason,
+                deadline_s=dec.batch_deadline_s,
+                rows_cap=dec.batch_rows_cap,
+            )
+        return dec.admit
+
+    def _mark_unhealthy(self, rep: _Replica, status: str) -> None:
+        self._c_unhealthy.inc()
+        self.tracer.event(
+            "fleet_replica_down", replica=rep.name, status=status
+        )
+
+    def _scored(self, rep: _Replica, now: float):
+        """Routing signals for one replica: ``(healthy, degraded, load,
+        health, admit)``. The full scrape (alert evaluation, control
+        decision, snapshot gauges) runs at most once per ``_HEALTH_TTL_S``;
+        between scrapes the hot path reuses the cached verdict with a live
+        in-flight read, so admission cost stays flat as the offered rate
+        climbs."""
+        cached = rep.score
+        if cached is None or now - rep.score_at >= _HEALTH_TTL_S:
+            cached = self._score(rep)
+            rep.score = cached
+            rep.score_at = now
+            healthy, _, load, h = cached
+            rep.admit = self._apply_control(rep, h) if healthy else True
+            self._g_queue_depth.set(int(h.get("queue_depth", 0)))
+            self._g_inflight.set(load)
+        healthy, degraded, _, h = cached
+        return healthy, degraded, int(rep.svc.in_flight), h, rep.admit
+
+    def _route_once(self, counts, mode):
+        """One admission pass over the current replica snapshot. Returns
+        (replica, replica-future) or raises RetryableRejection when every
+        admitting replica rejected. Returns (None, None) when no replica is
+        even admitting (all unhealthy/shed) — the caller decides whether
+        that is a shed, a retry, or an orphan requeue."""
+        with self._lock:
+            reps = list(self._replicas)
+        now = time.perf_counter()
+        scored = []
+        shed = False
+        for rep in reps:
+            healthy, degraded, load, h, admit = self._scored(rep, now)
+            if not healthy:
+                self._mark_unhealthy(rep, str(h.get("status")))
+                continue
+            if not admit:
+                shed = True
+                continue
+            # routed-count tie-break: equal-load replicas alternate instead
+            # of pinning to whichever sorts first
+            scored.append((degraded, load, rep.routed, id(rep), rep, h))
+        if not scored:
+            if shed:
+                self.metrics.counter("fleet_control_sheds").inc()
+                self._c_serve_rej.inc()
+                raise RetryableRejection(
+                    "fleet control shed: every replica past its shed "
+                    "occupancy under burn pressure",
+                    retry_after_s=self.retry_after_hint(),
+                )
+            return None, None
+        scored.sort(key=lambda t: t[:3])
+        rejected = 0
+        for degraded, load, _, _, rep, h in scored:
+            try:
+                fut = rep.svc.submit(counts, mode=mode)
+            except RetryableRejection:
+                rejected += 1
+                continue
+            except RuntimeError:
+                # shut down between scrape and submit (a swap drain or a
+                # dying worker closing intake): out of rotation this pass,
+                # and the cached "ok" is void — rescrape next pass
+                rep.score = None
+                self._mark_unhealthy(rep, "shutdown")
+                continue
+            rep.routed += 1
+            self._c_routed.inc()
+            return rep, fut
+        if rejected:
+            # every admitting replica rejected: fleet saturation
+            self._c_fleet_rej.inc()
+            self._c_serve_rej.inc()
+            raise RetryableRejection(
+                f"all {len(scored)} admitting replicas rejected "
+                "(fleet saturated); retry",
+                retry_after_s=self.retry_after_hint(),
+            )
+        return None, None
+
+    def submit(self, counts, mode: Optional[str] = None) -> Future:
+        """Route one request; returns a Future of AssignResult.
+
+        Raises :class:`RetryableRejection` only when every replica rejected
+        or control shed fleet-wide; RuntimeError when the fleet is shut
+        down or no replica is in rotation at all.
+        """
+        if self._closing or self._closed:
+            raise RuntimeError("FleetRouter is shut down")
+        t0 = time.perf_counter()
+        # two passes: a swap can atomically replace the replica list between
+        # the snapshot and the submit — the refreshed snapshot sees the new
+        # generation
+        for attempt in (0, 1):
+            rep, fut = self._route_once(counts, mode)
+            if rep is not None:
+                break
+        else:  # pragma: no cover - defensive; the loop always breaks or falls through with rep=None
+            rep, fut = None, None
+        if rep is None:
+            raise RuntimeError(
+                "no replica in rotation (all unhealthy or draining)"
+            )
+        self._accepted += 1
+        router_future: Future = Future()
+        self._chain(router_future, rep, fut, counts, mode, t0)
+        return router_future
+
+    def assign(
+        self, counts, mode: Optional[str] = None, timeout=None
+    ) -> AssignResult:
+        """Synchronous submit + wait."""
+        return self.submit(counts, mode=mode).result(timeout=timeout)
+
+    # -- completion + failover -----------------------------------------------
+
+    def _chain(self, router_future, rep, replica_future, counts, mode, t0):
+        def _done(fut):
+            err = fut.exception()
+            if err is None:
+                # observe BEFORE resolving: a caller that saw its result is
+                # already in the fleet histogram (loadgen metrics parity)
+                self._observe(t0)
+                router_future.set_result(fut.result())
+                return
+            # replica-death classification: the give-up path fails futures
+            # AND closes intake, so a not-"ok" status means the error was
+            # the replica dying, not this request failing on its merits
+            try:
+                dead = rep.svc.health().get("status") != "ok"
+            except Exception:  # graftlint: noqa[GL007] probe failure IS the signal (replica gone) — recorded just below via the fleet_failover event
+                dead = True
+            if dead and not self._closing:
+                self.metrics.counter("fleet_failovers").inc()
+                self.tracer.event(
+                    "fleet_failover",
+                    replica=rep.name,
+                    error=type(err).__name__,
+                )
+                self._orphans.put(_Orphan(router_future, counts, mode, t0))
+                return
+            self._completed += 1
+            router_future.set_exception(err)
+
+        replica_future.add_done_callback(_done)
+
+    def _observe(self, t0: float) -> None:
+        self._completed += 1
+        now = time.perf_counter()
+        self._h_latency.observe(now - t0)
+        # full-rule alert sweep paced like the health scrapes — per-request
+        # evaluation at saturation rates is pure overhead (the engine's own
+        # sampling window is far coarser than _HEALTH_TTL_S anyway)
+        if (
+            self._alerts is not None
+            and now - self._last_alert_eval >= _HEALTH_TTL_S
+        ):
+            self._last_alert_eval = now
+            self._alerts.evaluate()  # never raises
+
+    def _spawn_named(self, reference, name: str) -> AssignmentService:
+        """Spawn a replacement/standby replica, stamping its name at
+        construction when the template supports it (so even a
+        dies-in-the-ctor worker post-mortems by name)."""
+        if self._spawn_takes_name:
+            return self._spawn(reference, name)
+        svc = self._spawn(reference)
+        svc.replica_name = name
+        return svc
+
+    def _revive_dead(self, *, force: bool = True) -> int:
+        """Replace dead replicas from the spawn template (when one was
+        given). Returns how many came back. ``force=False`` (the idle-poll
+        path) rate-limits attempts to one per ``_REVIVE_INTERVAL_S``."""
+        if self._spawn is None or self._closing:
+            return 0
+        now = time.monotonic()
+        if not force and now - self._last_revive < _REVIVE_INTERVAL_S:
+            return 0
+        self._last_revive = now
+        revived = 0
+        with self._lock:
+            reps = list(self._replicas)
+            for i, rep in enumerate(reps):
+                try:
+                    ok = rep.svc.health().get("status") == "ok"
+                except Exception:  # graftlint: noqa[GL007] probe failure IS the signal (dead slot) — the revival it triggers is recorded via fleet_replica_revived
+                    ok = False
+                if ok:
+                    continue
+                base = rep.name.split("~", 1)[0]
+                fresh_name = f"{base}~{self._revivals + 1}"
+                try:
+                    svc = self._spawn_named(self.reference, fresh_name)
+                except Exception:  # graftlint: noqa[GL007] a failed revive (fault still planted) retries on the next failover pass instead of killing the thread
+                    continue
+                self._revivals += 1
+                fresh = _Replica(fresh_name, svc)
+                self._replicas[i] = fresh
+                revived += 1
+                self.tracer.event(
+                    "fleet_replica_revived", replica=fresh.name
+                )
+        if revived:
+            self.metrics.gauge("fleet_replicas").set(len(self._replicas))
+        return revived
+
+    def _failover_loop(self) -> None:
+        """Drain the orphan queue: re-route accepted requests off dead
+        replicas so no caller's future is lost to a crash. Runs until
+        close() sends the sentinel, then fails any stragglers loudly."""
+        while True:
+            try:
+                item = self._orphans.get(timeout=_FAILOVER_POLL_S)
+            except queue.Empty:
+                # self-healing even with nothing orphaned: a replica that
+                # died between requests (rate-limited — see above)
+                self._revive_dead(force=False)
+                continue
+            if item is _SENTINEL:
+                break
+            orphan: _Orphan = item
+            if orphan.future.done():
+                continue
+            orphan.attempts += 1
+            try:
+                rep, fut = self._route_once(orphan.counts, orphan.mode)
+            except RetryableRejection as e:
+                orphan.last_error = e
+                rep, fut = None, None
+            if rep is not None:
+                self._chain(
+                    orphan.future, rep, fut, orphan.counts, orphan.mode,
+                    orphan.t0,
+                )
+                continue
+            if orphan.attempts >= _ORPHAN_ATTEMPT_LIMIT or self._closing:
+                self._completed += 1
+                orphan.future.set_exception(
+                    orphan.last_error
+                    or RuntimeError(
+                        "fleet failover exhausted: no healthy replica"
+                    )
+                )
+                continue
+            self._revive_dead()
+            time.sleep(
+                min(
+                    _ORPHAN_BACKOFF_S * orphan.attempts,
+                    _ORPHAN_BACKOFF_MAX_S,
+                )
+            )
+            self._orphans.put(orphan)
+        # closing: anything still orphaned cannot be re-routed
+        while True:
+            try:
+                item = self._orphans.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL or item.future.done():
+                continue
+            self._completed += 1
+            item.future.set_exception(
+                RuntimeError("FleetRouter closed with orphaned requests")
+            )
+
+    # -- hot swap ------------------------------------------------------------
+
+    def swap_reference(self, reference, *, replicas: Optional[int] = None) -> dict:
+        """Zero-downtime version swap (ISSUE 18 part b).
+
+        Pre-builds the new artifact's per-bucket executables on standby
+        replicas (AssignmentService.warmup -> in-process AOT registry, then
+        the PR 13 disk cache — zero fresh compiles when this version was
+        ever served before), atomically flips admission to the standbys,
+        then drains the old generation: ``close()`` completes every
+        accepted request, so a loadgen run straddling the swap sees 0
+        failures. Returns a swap report with the compile delta measured
+        over the whole swap window (the pinned number)."""
+        if self._spawn is None:
+            raise RuntimeError(
+                "swap_reference needs the spawn template "
+                "(build the router via serve.fleet.build_fleet)"
+            )
+        if self._closing or self._closed:
+            raise RuntimeError("FleetRouter is shut down")
+        t0 = time.perf_counter()
+        compiles = global_metrics().counter("executable_compiles")
+        compiles0 = compiles.value
+        with self.tracer.span("fleet_swap") as sp:
+            with self._lock:
+                n = replicas if replicas is not None else len(self._replicas)
+                gen = self._gen + 1
+            standby = [
+                _Replica(
+                    f"r{i}.v{gen}",
+                    self._spawn_named(reference, f"r{i}.v{gen}"),
+                )
+                for i in range(n)
+            ]
+            with self._lock:
+                old, self._replicas = self._replicas, standby
+                self._gen = gen
+                self.reference = reference
+            drained = 0
+            for rep in old:
+                before = rep.svc.health()
+                rep.svc.close()  # drains: every accepted request completes
+                drained += int(before.get("in_flight", 0))
+            swap_compiles = int(compiles.value - compiles0)
+            wall_s = round(time.perf_counter() - t0, 4)
+            self.metrics.counter("fleet_swaps").inc()
+            if swap_compiles:
+                self.metrics.counter("fleet_swap_compiles").inc(swap_compiles)
+            self.metrics.gauge("fleet_replicas").set(n)
+            sp.set(
+                generation=gen, replicas=n, swap_compiles=swap_compiles,
+                drained_in_flight=drained,
+            )
+        self.tracer.event(
+            "fleet_swap",
+            generation=gen,
+            replicas=n,
+            swap_compiles=swap_compiles,
+            wall_s=wall_s,
+        )
+        return {
+            "generation": gen,
+            "replicas": n,
+            "swap_compiles": swap_compiles,
+            "drained_in_flight": drained,
+            "wall_s": wall_s,
+        }
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> dict:
+        """Fleet-level /healthz: per-replica scrapes under their router
+        names, the routed split, and the fleet alert state (evaluated over
+        the router's own registry — rejections and latency one level up)."""
+        with self._lock:
+            reps = list(self._replicas)
+            gen = self._gen
+        replica_health = {}
+        for rep in reps:
+            try:
+                replica_health[rep.name] = rep.svc.health()
+            except Exception as e:  # graftlint: noqa[GL007] probe failure IS the signal — recorded in the returned scrape as the replica's error status
+                replica_health[rep.name] = {
+                    "status": f"error:{type(e).__name__}"
+                }
+        status = (
+            "closed" if self._closed else "draining" if self._closing
+            else "ok" if any(
+                h.get("status") == "ok" for h in replica_health.values()
+            ) else "degraded"  # router alive, zero replicas in rotation
+        )
+        alerts_active: dict = {}
+        last_alert = None
+        if self._alerts is not None:
+            alerts_active = self._alerts.evaluate()
+            last_alert = self._alerts.last_alert
+        return {
+            "status": status,
+            "generation": gen,
+            "replicas": replica_health,
+            "routed": self.routed_per_replica(),
+            "accepted": self._accepted,
+            "completed": self._completed,
+            "in_flight": self._accepted - self._completed,
+            "alerts_active": sorted(alerts_active),
+            "last_alert": dict(last_alert) if last_alert else None,
+        }
+
+    def run_record(self, config=None) -> RunRecord:
+        """Snapshot the router's spans/metrics as a RunRecord (for
+        tools/report.py's "== fleet ==" table)."""
+        from consensusclustr_tpu.utils.backend import default_backend
+
+        return RunRecord.from_tracer(
+            self.tracer, config=config, backend=default_backend(),
+            include_global_metrics=False,
+        )

@@ -305,6 +305,7 @@ class AssignmentService:
         metrics_port: Optional[int] = None,
         resource_sample_ms: Optional[int] = None,
         retry_attempts: Optional[int] = None,
+        replica_name: str = "",
     ) -> None:
         if mode not in ("robust", "granular"):
             raise ValueError(f"mode must be 'robust' or 'granular'; got {mode!r}")
@@ -343,6 +344,17 @@ class AssignmentService:
         attach_flight(self.tracer)
         self._alerts = attach_alerts(self.tracer)
         self._stall_floor_s = getattr(cfg, "stall_floor_s", None)
+        # ISSUE 18 (fleet): the adaptive-control surface. An armed
+        # ControlPolicy (serve/control.py) sets these through the router;
+        # the defaults reproduce the pre-fleet worker exactly — no timed
+        # gather, no row cap (the off-is-free pin in tests/test_fleet.py).
+        # replica_name is stamped by FleetRouter — at CONSTRUCTION when the
+        # router spawns the replica (a worker with a permanent fault can
+        # _fail_all before the ctor even returns, and the post-mortem must
+        # still name the dead replica) or post-hoc for adopted services.
+        self.batch_deadline_s: float = 0.0
+        self.batch_rows_cap: Optional[int] = None
+        self.replica_name: str = str(replica_name)
         self._tracker = CompileTracker()
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._thread: Optional[threading.Thread] = None
@@ -638,6 +650,7 @@ class AssignmentService:
             FAIL_ALL_FLIGHT, log=self.tracer,
             error=type(err).__name__, message=str(err)[:500],
             worker_restarts=self._worker_restarts,
+            replica=self.replica_name,
         )
         self._closing = True
         while self._pending:
@@ -686,8 +699,37 @@ class AssignmentService:
                 item.t_dequeue = time.perf_counter()
                 pending.append(item)
             self.metrics.gauge("queue_depth").set(self._queue.qsize())
+            # ISSUE 18 control surface: an armed ControlPolicy may set a
+            # bounded gather deadline (wait briefly for fuller batches) and
+            # a per-micro-batch row cap (smaller pad buckets under latency
+            # pressure). The defaults — 0.0 / None — skip both branches, so
+            # the disarmed worker is the pre-fleet worker verbatim.
+            cap = min(int(self.batch_rows_cap or self.max_batch),
+                      self.max_batch)
+            deadline_s = self.batch_deadline_s
+            if deadline_s > 0.0 and not self._drained:
+                have = sum(r.rows for r in pending)
+                t_end = time.perf_counter() + deadline_s
+                while have < cap:
+                    remaining = t_end - time.perf_counter()
+                    if remaining <= 0.0:
+                        break
+                    try:
+                        item = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if item is _SENTINEL:
+                        self._drained = True
+                        break
+                    item.t_dequeue = time.perf_counter()
+                    pending.append(item)
+                    have += item.rows
+                self.metrics.gauge("queue_depth").set(self._queue.qsize())
             batch, rows = [], 0
-            while pending and rows + pending[0].rows <= self.max_batch:
+            # ``not batch or`` guarantees progress when a request alone
+            # exceeds a control row cap (submit() already bounds rows to
+            # max_batch, so with cap == max_batch this is the old condition)
+            while pending and (not batch or rows + pending[0].rows <= cap):
                 req = pending.popleft()
                 batch.append(req)
                 rows += req.rows
@@ -834,6 +876,14 @@ class AssignmentService:
         rate = served / span
         waiting = self._queue.qsize() + 1  # +1: the rejected request itself
         return round(min(max(waiting / rate, 0.001), 30.0), 4)
+
+    @property
+    def in_flight(self) -> int:
+        """Requests accepted but not yet resolved — the cheap live load
+        signal FleetRouter reads on every admission (plain counter
+        subtraction; the full :meth:`health` scrape evaluates alert rules
+        and is paced to a TTL on the router's hot path)."""
+        return self._accepted - self._completed
 
     @property
     def worker_restarts(self) -> int:

@@ -1,0 +1,15 @@
+"""GL002 fixture (ISSUE 18): a fleet knob read but never registered.
+
+The fleet layer added CCTPU_FLEET_CONTROL / CCTPU_FLEET_REPLICAS /
+CCTPU_FLEET_CONTROL_DEADLINE_MS to obs.schema.ENV_KNOBS; this module
+simulates the drift the rule exists to catch — a new CCTPU_FLEET_* read
+that skipped the registry. The knob name below must stay OUT of
+ENV_KNOBS forever: the test copies this file into a synthetic package
+root and asserts GL002 exits 3 naming it.
+"""
+
+import os
+
+
+def fleet_spares() -> int:
+    return int(os.environ.get("CCTPU_FLEET_SPARES_FOO", "0") or 0)

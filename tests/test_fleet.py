@@ -1,0 +1,503 @@
+"""Fleet layer (ISSUE 18): multi-replica admission router, zero-downtime
+hot-swap, and alert-driven adaptive control.
+
+Covers: least-loaded routing with the round-robin tie-break, routing away
+from an unhealthy replica, the fleet-wide RetryableRejection contract
+(raised only when EVERY replica rejects — total saturation), label parity
+between a fleet and a bare AssignmentService, the hot-swap pin (a
+subprocess loadgen run straddling ``swap_reference`` with 0 failed
+requests and 0 swap-time compiles), the adaptive-control policy table,
+the off-is-free pin (disarmed control leaves labels AND the per-replica
+work counters bit-identical to a routerless service), schema v10
+round-trip (fleet metric/event/span vocabulary + report rendering), and
+the bench zero-shape parity for the ``fleet_slo`` rung.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensusclustr_tpu.serve.control import (
+    BURN_DEADLINE_FACTOR,
+    ControlDecision,
+    ControlPolicy,
+    NO_CONTROL,
+    SHED_OCCUPANCY,
+)
+from consensusclustr_tpu.serve.fleet import build_fleet, fleet_replicas
+from consensusclustr_tpu.serve.router import FleetRouter
+from consensusclustr_tpu.serve.service import (
+    AssignmentService,
+    RetryableRejection,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GENES = 32
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def art():
+    lg = _load_tool("loadgen")
+    artifact, _ = lg.synthetic_artifact(128, GENES, seed=0)
+    return artifact
+
+
+def _queries(sizes=(1, 3, 5), seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.poisson(2.0, size=(s, GENES)).astype(np.float32) for s in sizes
+    ]
+
+
+class TestRouting:
+    def test_balances_and_duck_types_like_a_service(self, art):
+        with build_fleet(
+            art, 2, queue_depth=32, max_batch=16, buckets=(16,)
+        ) as fleet:
+            assert len(fleet.replicas) == 2
+            assert fleet.max_batch == 16
+            assert fleet.generation == 0
+            for q in _queries() + _queries(seed=2):
+                fleet.assign(q, timeout=120)
+            routed = fleet.routed_per_replica()
+            assert sum(routed.values()) == 6
+            # sequential idle-fleet submits tie on load; the routed-count
+            # tie-break spreads them instead of pinning one replica
+            assert all(v > 0 for v in routed.values()), routed
+            h = fleet.health()
+            assert h["status"] == "ok"
+            assert set(h["replicas"]) == {"r0", "r1"}
+            assert h["completed"] == 6
+            assert isinstance(h["alerts_active"], list)
+            m = fleet.metrics
+            assert m.counter("fleet_requests_routed").value == 6
+            assert m.counter("fleet_rejections").value == 0
+
+    def test_labels_match_single_service(self, art):
+        queries = _queries()
+        with AssignmentService(
+            art, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as svc:
+            want = [svc.assign(q, timeout=120).labels for q in queries]
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            got = [fleet.assign(q, timeout=120).labels for q in queries]
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+
+    def test_routes_away_from_unhealthy_replica(self, art):
+        svcs = [
+            AssignmentService(art, queue_depth=8, max_batch=16, buckets=(16,))
+            for _ in range(2)
+        ]
+        router = FleetRouter(svcs)
+        try:
+            svcs[0].close()  # r0 now reports closed -> unhealthy
+            for q in _queries():
+                router.assign(q, timeout=120)
+            routed = router.routed_per_replica()
+            assert routed.get("r0", 0) == 0
+            assert routed.get("r1", 0) == 3
+            assert router.metrics.counter("fleet_replica_unhealthy").value >= 1
+            h = router.health()
+            assert h["status"] == "ok"  # one live replica keeps the fleet up
+            assert h["replicas"]["r0"]["status"] != "ok"
+        finally:
+            router.close()
+
+    def test_admission_scrape_is_paced(self, art):
+        # the hot path must NOT pay a full health scrape (alert-rule
+        # evaluation) per request — scrapes are TTL-paced and routing
+        # between scrapes rides the cached verdict + live in_flight read
+        svcs = [
+            AssignmentService(art, queue_depth=32, max_batch=16, buckets=(16,))
+            for _ in range(2)
+        ]
+        calls = {"n": 0}
+        real_health = AssignmentService.health
+
+        def counting_health(self):
+            calls["n"] += 1
+            return real_health(self)
+
+        with FleetRouter(svcs) as fleet:
+            import unittest.mock as mock
+
+            with mock.patch.object(
+                AssignmentService, "health", counting_health
+            ):
+                futs = [fleet.submit(_queries()[0]) for _ in range(40)]
+                for f in futs:
+                    f.result(timeout=120)
+            # 40 submits x 2 replicas would be 80 scrapes unpaced; the TTL
+            # (50 ms) allows only a handful over this sub-second burst
+            assert calls["n"] < 20, calls["n"]
+            assert all(
+                isinstance(s.in_flight, int) for s in fleet.replicas
+            )
+
+    def test_fleet_rejects_only_at_total_saturation(self, art):
+        # workers never started: each replica's queue (depth 2) fills and
+        # stays full, so the Nth submit maps exactly to queue state
+        depth = 2
+        svcs = [
+            AssignmentService(
+                art, queue_depth=depth, max_batch=16, buckets=(16,),
+                start=False, warmup=False,
+            )
+            for _ in range(2)
+        ]
+        router = FleetRouter(svcs)
+        q = _queries(sizes=(1,))[0]
+        accepted = 0
+        try:
+            with pytest.raises(RetryableRejection):
+                for _ in range(10):
+                    router.submit(q)
+                    accepted += 1
+            # both queues had to fill before the fleet turned anyone away
+            assert accepted == 2 * depth
+            assert router.metrics.counter("fleet_rejections").value >= 1
+            assert (
+                router.metrics.counter("fleet_requests_routed").value
+                == accepted
+            )
+        finally:
+            for s in svcs:
+                s.start()  # drain the queued futures before close
+            router.close()
+
+    def test_replica_count_resolution(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_FLEET_REPLICAS", raising=False)
+        assert fleet_replicas() == 2  # the default
+        monkeypatch.setenv("CCTPU_FLEET_REPLICAS", "3")
+        assert fleet_replicas() == 3
+        assert fleet_replicas(5) == 5  # explicit arg wins
+
+        class Cfg:
+            fleet_replicas = 4
+
+        assert fleet_replicas(None, Cfg()) == 4  # config beats env
+        with pytest.raises(ValueError):
+            fleet_replicas(0)
+
+    def test_config_validates_fleet_replicas(self):
+        from consensusclustr_tpu.config import ClusterConfig
+
+        with pytest.raises(ValueError):
+            ClusterConfig(fleet_replicas=0)
+        assert ClusterConfig(fleet_replicas=2).fleet_replicas == 2
+
+
+_SWAP_PIN_SCRIPT = """
+import importlib.util, json, os, sys, threading, time
+
+repo = sys.argv[1]
+sys.path.insert(0, repo)
+spec = importlib.util.spec_from_file_location(
+    "lg", os.path.join(repo, "tools", "loadgen.py"))
+lg = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lg)
+
+from consensusclustr_tpu.serve.fleet import build_fleet
+
+art, _ = lg.synthetic_artifact(128, 32, seed=0)
+mix = ((1, 0.5), (4, 0.5))
+offsets = lg.schedule_offsets(30.0, seed=3, duration=1.2)
+res = {}
+with build_fleet(art, 2, queue_depth=32, max_batch=16, buckets=(16,)) as fleet:
+    t = threading.Thread(
+        target=lambda: res.update(
+            lg.run_open_loop(fleet, offsets, mix, 32, seed=1)),
+        daemon=True)
+    t.start()
+    time.sleep(0.5)  # mid-run: the swap straddles live traffic
+    art2, _ = lg.synthetic_artifact(128, 32, seed=0)  # same content/sha
+    swap = fleet.swap_reference(art2)
+    t.join(timeout=300)
+print(json.dumps({
+    "failed": res.get("failed"), "completed": res.get("completed"),
+    "accepted": res.get("accepted"), "rejected": res.get("rejected"),
+    "swap_compiles": swap["swap_compiles"],
+    "generation": swap["generation"],
+}))
+"""
+
+
+class TestHotSwap:
+    def test_swap_requires_spawn_template(self, art):
+        svc = AssignmentService(art, queue_depth=4, max_batch=16, buckets=(16,))
+        router = FleetRouter([svc])
+        try:
+            with pytest.raises(RuntimeError):
+                router.swap_reference(art)
+        finally:
+            router.close()
+
+    def test_swap_inprocess_zero_compiles(self, art):
+        # same artifact content -> same sha -> the in-process AOT registry
+        # serves the standby warm-up; the swap window compiles nothing
+        lg = _load_tool("loadgen")
+        art2, _ = lg.synthetic_artifact(128, GENES, seed=0)
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+            report = fleet.swap_reference(art2)
+            assert report["generation"] == 1
+            assert report["swap_compiles"] == 0
+            assert report["replicas"] == 2
+            # the flipped fleet still serves
+            res = fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+            assert res.labels.shape == (2,)
+            assert set(fleet.routed_per_replica()) == {"r0.v1", "r1.v1"}
+            assert fleet.metrics.counter("fleet_swaps").value == 1
+
+    def test_swap_straddling_loadgen_has_zero_failures(self, tmp_path):
+        # the ISSUE 18 pin, isolated in a subprocess so the global compile
+        # counter sees ONLY this fleet: a loadgen run straddles the swap
+        # with 0 failed requests and 0 swap-time executable compiles
+        script = tmp_path / "swap_pin.py"
+        script.write_text(_SWAP_PIN_SCRIPT)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        p = subprocess.run(
+            [sys.executable, str(script), REPO_ROOT],
+            capture_output=True, text=True, timeout=570, env=env,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        out = json.loads(p.stdout.strip().splitlines()[-1])
+        assert out["failed"] == 0
+        assert out["swap_compiles"] == 0
+        assert out["generation"] == 1
+        assert out["completed"] == out["accepted"]
+        assert out["completed"] > 0
+
+
+class TestControl:
+    def test_disarmed_by_default(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_FLEET_CONTROL", raising=False)
+        policy = ControlPolicy()
+        assert not policy.enabled
+        assert policy.decide({"alerts_active": ["serve_p99_high"]}, 8) \
+            is NO_CONTROL
+
+    def test_arming_resolution(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_FLEET_CONTROL", "1")
+        assert ControlPolicy().enabled
+        monkeypatch.setenv("CCTPU_FLEET_CONTROL", "off")
+        assert not ControlPolicy().enabled
+
+        class Cfg:
+            fleet_control = True
+
+        assert ControlPolicy(config=Cfg()).enabled
+        assert not ControlPolicy(False, config=Cfg()).enabled  # arg wins
+
+    def test_policy_table(self):
+        policy = ControlPolicy(True)
+        calm = policy.decide({"alerts_active": []}, 8)
+        assert calm == ControlDecision(
+            policy.deadline_s, None, True, "calm"
+        )
+        latency = policy.decide(
+            {"alerts_active": ["serve_p99_high"], "max_batch": 16}, 8
+        )
+        assert latency.batch_deadline_s == 0.0
+        assert latency.batch_rows_cap == 8  # halved
+        assert latency.admit and latency.reason == "latency"
+        burn = policy.decide(
+            {"alerts_active": ["slo_burn_rate_high"], "queue_depth": 0}, 8
+        )
+        assert burn.batch_deadline_s == pytest.approx(
+            policy.deadline_s * BURN_DEADLINE_FACTOR
+        )
+        assert burn.admit and burn.reason == "burn"
+        shed = policy.decide(
+            {
+                "alerts_active": ["slo_burn_rate_high"],
+                "queue_depth": int(SHED_OCCUPANCY * 8) + 1,
+            },
+            8,
+        )
+        assert not shed.admit  # past SHED_OCCUPANCY the door sheds
+
+    def test_off_is_free_labels_and_work(self, art, monkeypatch):
+        # the PR 8/14/16-style pin: disarmed control leaves the worker's
+        # batch path untouched — identical labels AND identical per-service
+        # work counters vs a routerless AssignmentService
+        monkeypatch.delenv("CCTPU_FLEET_CONTROL", raising=False)
+        queries = _queries()
+
+        def drive(target):
+            return [target.assign(q, timeout=120).labels for q in queries]
+
+        with AssignmentService(
+            art, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as svc:
+            want = drive(svc)
+            bare_counters = {
+                k: c.value for k, c in svc.metrics.counters.items()
+            }
+        with build_fleet(
+            art, 1, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            got = drive(fleet)
+            rep = fleet._replicas[0]
+            assert rep.svc.batch_deadline_s == 0.0
+            assert rep.svc.batch_rows_cap is None
+            fleet_counters = {
+                k: c.value for k, c in rep.svc.metrics.counters.items()
+            }
+            decisions = fleet.metrics.counter("fleet_control_decisions").value
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+        assert fleet_counters == bare_counters
+        assert decisions == 0
+
+    def test_armed_control_applies_batch_deadline(self, art, monkeypatch):
+        monkeypatch.setenv("CCTPU_FLEET_CONTROL", "1")
+        with build_fleet(
+            art, 1, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            assert fleet.control.enabled
+            fleet.assign(_queries(sizes=(1,))[0], timeout=120)
+            rep = fleet._replicas[0]
+            # calm pressure: the base gather deadline landed on the worker
+            assert rep.svc.batch_deadline_s == pytest.approx(
+                fleet.control.deadline_s
+            )
+            assert fleet.control.deadline_s == pytest.approx(0.002)
+            assert (
+                fleet.metrics.counter("fleet_control_decisions").value >= 1
+            )
+
+    def test_deadline_knob(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_FLEET_CONTROL_DEADLINE_MS", "5")
+        assert ControlPolicy(True).deadline_s == pytest.approx(0.005)
+        monkeypatch.setenv("CCTPU_FLEET_CONTROL_DEADLINE_MS", "-1")
+        with pytest.raises(ValueError):
+            ControlPolicy(True)
+
+
+class TestSchemaV10:
+    def test_schema_version(self):
+        from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+
+        assert SCHEMA_VERSION == 10
+
+    def test_fleet_vocabulary_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        for metric in (
+            "fleet_requests_routed", "fleet_rejections", "fleet_failovers",
+            "fleet_replica_unhealthy", "fleet_replicas",
+            "fleet_replica_queue_depth", "fleet_replica_inflight",
+            "fleet_swaps", "fleet_swap_compiles", "fleet_control_sheds",
+            "fleet_control_decisions",
+        ):
+            assert metric in schema.METRIC_HELP, metric
+        for kind in (
+            "fleet_start", "fleet_drain", "fleet_replica_down",
+            "fleet_replica_revived", "fleet_failover", "fleet_swap",
+            "fleet_control",
+        ):
+            assert kind in schema.EVENT_KINDS, kind
+        assert "fleet_swap" in schema.SPAN_NAMES
+
+    def test_run_record_round_trip(self, art, tmp_path):
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            for q in _queries():
+                fleet.assign(q, timeout=120)
+        rec = fleet.run_record()  # post-close: fleet_drain is in the ring
+        d = json.loads(rec.to_json())
+        assert d["schema"] == 10
+        counters = (d.get("metrics") or {}).get("counters") or {}
+        assert counters.get("fleet_requests_routed") == 3
+        kinds = {e.get("kind") for e in d.get("events") or []}
+        assert "fleet_start" in kinds and "fleet_drain" in kinds
+        # the report tool renders it (including the new fleet table)
+        path = tmp_path / "fleet_record.jsonl"
+        path.write_text(rec.to_json() + "\n")
+        report = _load_tool("report")
+        text = report.render(json.loads(path.read_text()))
+        assert "== fleet ==" in text
+        assert "requests routed" in text
+        assert "WARNING: unknown schema" not in text  # v10 is known
+
+    def test_report_without_fleet_metrics_placeholder(self):
+        report = _load_tool("report")
+        text = report.render({"schema": 10, "metrics": {"counters": {}}})
+        assert "(no fleet activity)" in text
+
+
+class TestBenchShapes:
+    def test_zero_shape_matches_success_keys(self):
+        # the failure rung must carry exactly the keys the success path
+        # emits, so bench_diff sees one stable vocabulary (ast-read — no
+        # bench import, which would pull the whole accelerator stack)
+        import ast
+
+        tree = ast.parse(
+            open(os.path.join(REPO_ROOT, "bench.py"), encoding="utf-8").read()
+        )
+        zero = None
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    getattr(t, "id", None) == "_FLEET_SLO_ZERO"
+                    for t in node.targets
+                )
+            ):
+                zero = ast.literal_eval(node.value)
+        assert zero is not None, "bench.py lost _FLEET_SLO_ZERO"
+        assert set(zero) == {
+            "fleet_slo", "fleet_p99_ms", "fleet_rejection_rate",
+            "fleet_routed", "fleet_swap_compiles",
+        }
+        assert zero["fleet_slo"] == {"steps": []}
+
+    def test_committed_swap_artifact_pins_zero_downtime(self):
+        # the ISSUE 18 acceptance artifact: a loadgen run straddling a
+        # hot-swap, committed at the repo root (LOADGEN_r07.json precedent)
+        path = os.path.join(REPO_ROOT, "LOADGEN_r18_swap.json")
+        art = json.load(open(path, encoding="utf-8"))
+        assert art["target"] == "fleet" and art["replicas"] == 2
+        assert art["failed"] == 0
+        assert art["swap"]["swap_compiles"] == 0
+        assert art["swap"]["generation"] == 1
+        assert art["completed"] == art["accepted"] > 0
+        assert art["obs_schema"] >= 10
+        # the swap flipped admission mid-run: post-swap generation names
+        # appear in the routed split
+        assert any(".v1" in name for name in art["routed"])
+        assert art["metrics_parity"]["within_one_bucket"]
+        assert art["phase_parity"]["within_5pct"]
+
+    def test_bench_diff_knows_fleet_rungs(self):
+        bd = _load_tool("bench_diff")
+        for key in (
+            "fleet_p99_ms", "fleet_rejection_rate", "fleet_swap_compiles"
+        ):
+            assert key in bd.RUNGS
+            assert bd.RUNGS[key] == -1  # lower is better
+        assert bd.RUNG_ALIASES["fleet_p99"] == "fleet_p99_ms"
+        assert bd.RUNG_ALIASES["swap_compiles"] == "fleet_swap_compiles"

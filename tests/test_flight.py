@@ -144,14 +144,21 @@ class TestFlightRecorder:
         rec = attach_flight(tr)
         assert rec is not None and tr.flight is rec
         assert attach_flight(tr) is rec  # idempotent: no double-wrap
-        n0 = len(rec.events)
-        tr.event("boot_chunk_done", i=1)
-        assert len(rec.events) == n0 + 1  # exactly once despite re-attach
+        # count by a unique marker, not ring length: the rings are bounded
+        # (deque maxlen), so in a long-lived process a full ring keeps the
+        # same length on append — but a double-wrapped tracer would still
+        # show the marker twice
+        marker = 987654
+        tr.event("boot_chunk_done", i=marker)
+        hits = [
+            e for e in rec.events
+            if e.get("kind") == "boot_chunk_done" and e.get("i") == marker
+        ]
+        assert len(hits) == 1  # exactly once despite re-attach
         assert rec.events[-1]["kind"] == "boot_chunk_done"
-        s0 = len(rec.spans)
         with tr.span("ingest"):
             tr.metrics.counter("boots_completed").inc()
-        assert len(rec.spans) >= s0 + 1
+        assert rec.spans[-1]["name"] == "ingest"
         assert rec.snapshots[-1]["phase"] == "ingest"
 
     def test_path_resolution_order(self, monkeypatch, tmp_path):
@@ -459,7 +466,7 @@ class TestAlertRules:
 
 class TestSchemaV8:
     def test_registry_entries(self):
-        assert obs_schema.SCHEMA_VERSION == 9
+        assert obs_schema.SCHEMA_VERSION == 10
         for kind in (
             "stall_detected", "postmortem_dump", "alert_raised",
             "alert_cleared",
@@ -485,7 +492,7 @@ class TestSchemaV8:
             tr.metrics.counter("boots_completed").inc()
         tr.flight.dump(MANUAL_FLIGHT, path=rec_path)
         rec = RunRecord.from_tracer(tr)
-        assert rec.schema == 9
+        assert rec.schema == 10
         assert rec.postmortem_path == rec_path
         assert rec.alerts is not None and rec.alerts["active"] == {}
         path = str(tmp_path / "rec.jsonl")

@@ -337,6 +337,36 @@ class TestEnvKnobRegistry:
         assert hits, [f.message for f in res.violations]
         assert "pr16_unregistered_knob.py" in hits[0].path
 
+    def test_fleet_knobs_are_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        # ISSUE 18: the fleet-layer knobs ride the registry like every
+        # other CCTPU_* read
+        for knob in ("CCTPU_FLEET_CONTROL", "CCTPU_FLEET_REPLICAS",
+                     "CCTPU_FLEET_CONTROL_DEADLINE_MS"):
+            assert knob in schema.ENV_KNOBS
+
+    def test_unregistered_fleet_knob_exits_three(self, tmp_path):
+        # ISSUE 18 fixture: a CCTPU_FLEET_* read that skipped ENV_KNOBS
+        # must trip GL002 at exit 3 naming the knob (same synthetic
+        # package-root shape as the profiler-knob test above)
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        src = open(
+            _fixture("pr18_unregistered_fleet_knob.py"), encoding="utf-8"
+        ).read()
+        (pkg / "pr18_unregistered_fleet_knob.py").write_text(src)
+        res = core.run(
+            root=str(tmp_path), select=["GL002"], baseline_path=None
+        )
+        assert res.exit_code == 3
+        hits = [
+            f for f in res.violations
+            if f.code == "GL002" and "CCTPU_FLEET_SPARES_FOO" in f.message
+        ]
+        assert hits, [f.message for f in res.violations]
+        assert "pr18_unregistered_fleet_knob.py" in hits[0].path
+
 
 class TestCheckObsSchemaWrapper:
     """The thin wrapper keeps its import surface and CLI contract."""

@@ -121,7 +121,7 @@ class TestWorkLedgerCore:
         with tr.span("boots"):
             tr.metrics.counter("boots_completed").inc(4)
         rec = RunRecord.from_tracer(tr)
-        assert rec.schema == 9
+        assert rec.schema == 10
         assert rec.work_ledger is not None
         assert rec.work_ledger["counters"]["boots_completed"] == 4
         path = str(tmp_path / "rec.jsonl")

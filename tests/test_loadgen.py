@@ -513,14 +513,21 @@ class TestBenchDiffServingGates:
             capture_output=True, text=True, timeout=60,
         )
 
-    def test_check_relaxes_adjacent_bump_only(self, tmp_path):
-        """The committed r06 (v4) / r07 (v5) pair: --check warns on an
-        adjacent schema bump instead of refusing; a non-adjacent jump still
-        exits 2; explicit-file mode stays strict even for adjacent."""
+    def test_check_relaxes_forward_bumps_only(self, tmp_path):
+        """--check warns on any FORWARD schema bump instead of refusing —
+        adjacent (the committed r06 v4 / r07 v5 pair) or multi-step (the
+        committed r14 v8 / r18 v10 pair: PR 16 bumped to 9 without a BENCH
+        artifact, so the next committed pair spans two versions). A
+        BACKWARD jump still exits 2 (a committed NEW older than OLD is
+        never a release sequence), and explicit-file mode stays strict
+        even for adjacent."""
         proc = self._run_check(tmp_path, 4, 5)
         assert proc.returncode == 0, proc.stderr
-        assert "adjacent schema bump" in proc.stderr
+        assert "adjacent forward schema bump" in proc.stderr
         proc = self._run_check(tmp_path, 3, 5)
+        assert proc.returncode == 0, proc.stderr
+        assert "2-step forward schema bump" in proc.stderr
+        proc = self._run_check(tmp_path, 5, 3)
         assert proc.returncode == 2
         strict = self._run(tmp_path, _slo_payload(schema=4),
                            _slo_payload(schema=5))

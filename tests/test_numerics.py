@@ -394,14 +394,14 @@ class TestSchemaV6:
         return RunRecord.from_tracer(tr)
 
     def test_record_round_trip(self, tmp_path):
-        assert SCHEMA_VERSION == 9
+        assert SCHEMA_VERSION == 10
         rec = self._audited_record()
         path = str(tmp_path / "rec.jsonl")
         rec.write(path)
         from consensusclustr_tpu.obs import load_records
 
         back = load_records(path)[-1]
-        assert back.schema == 9
+        assert back.schema == 10
         assert back.numerics == rec.numerics
         assert back.numerics["level"] == "audit"
         assert back.numerics["nonfinite"] == 1
@@ -410,7 +410,7 @@ class TestSchemaV6:
         ]
 
     def test_registry_entries(self):
-        assert obs_schema.SCHEMA_VERSION == 9
+        assert obs_schema.SCHEMA_VERSION == 10
         assert "pca" in obs_schema.NUMERIC_CHECKPOINTS
         assert "numeric_fingerprint" in obs_schema.EVENT_KINDS
         assert "numerics_nonfinite" in obs_schema.METRIC_NAMES

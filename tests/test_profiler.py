@@ -333,7 +333,7 @@ class TestOffIsFree:
 
 class TestSchemaV9:
     def test_registries(self):
-        assert obs_schema.SCHEMA_VERSION == 9
+        assert obs_schema.SCHEMA_VERSION == 10
         assert len(obs_schema.PROGRAM_NAMES) >= 10
         assert "_boot_batch" in obs_schema.PROGRAM_NAMES
         assert obs_schema.PROGRAM_PROFILE_FIELDS == frozenset(
@@ -366,7 +366,7 @@ class TestSchemaV9:
 
     def test_record_round_trip(self, tmp_path):
         rec = self._record_with_profile()
-        assert rec.schema == 9
+        assert rec.schema == 10
         assert rec.program_profile is not None
         assert rec.profile is not None and rec.profile["stacks"]
         path = str(tmp_path / "rec.jsonl")
@@ -374,7 +374,7 @@ class TestSchemaV9:
         from consensusclustr_tpu.obs import load_records
 
         back = load_records(path)[-1]
-        assert back.schema == 9
+        assert back.schema == 10
         assert back.program_profile == rec.program_profile
         assert back.profile == rec.profile
 

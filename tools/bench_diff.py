@@ -81,10 +81,13 @@ Contracts:
     failing CI on every first post-bump round against an unstamped
     historical artifact would force --allow-schema-drift into the hook,
     disabling the fence exactly where it matters. For the same reason the
-    committed-pair modes (--check/--latest) relax an ADJACENT bump
-    (old + 1 == new) to a warning: every schema-bumping PR lands exactly
-    one such pair in history. Non-adjacent jumps, and any drift between
-    explicitly named files, still refuse.
+    committed-pair modes (--check/--latest) relax any FORWARD bump
+    (new > old) to a warning naming its span: every schema-bumping PR lands
+    one such pair in history, and a PR that bumps without committing an
+    artifact (v8 -> v10 across PR 16) widens the next pair past one step —
+    direction, not adjacency, is what a release sequence guarantees.
+    Backward jumps, and any drift between explicitly named files, still
+    refuse.
   * **named-rung gates** — ``--gate RUNG:MIN_FACTOR`` computes a regression
     factor per rung (new/old for higher-is-better rungs, old/new for
     lower-is-better like latency; the direction registry is RUNGS below) and
@@ -165,6 +168,14 @@ RUNGS: Dict[str, int] = {
     "warm_start.warm_warmup_s": -1,
     "warm_start.warm_aot_hits": +1,
     "warm_start.aot_entries": +1,
+    # fleet-SLO ladder (obs schema v10, ISSUE 18): the 2-replica saturation
+    # step — fleet tail and shed fraction mirror the single-replica rungs
+    # above at identical offered rates; fleet_swap_compiles is the
+    # hot-swap-under-load pin (0 while the AOT caches hold — any regression
+    # means a version swap started tracing at flip time)
+    "fleet_p99_ms": -1,
+    "fleet_rejection_rate": -1,
+    "fleet_swap_compiles": -1,
 }
 
 # Gate-spec shorthands: --gate compiles:0.9 reads better than the full
@@ -183,6 +194,10 @@ RUNG_ALIASES: Dict[str, str] = {
     # ISSUE 13: the cost-model bytes gate and the warm-start trace gate
     "bytes": "est_bytes",
     "warm_compiles": "warm_start.warm_compiles",
+    # ISSUE 18: the fleet tail gate and the swap-time compile pin
+    "fleet_p99": "fleet_p99_ms",
+    "fleet_rejections": "fleet_rejection_rate",
+    "swap_compiles": "fleet_swap_compiles",
 }
 
 # Wall-derived rungs whose regressions the noise-aware downgrade (high
@@ -498,15 +513,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"({s_old} -> {s_new}); schema fence skipped",
                 file=sys.stderr,
             )
-        elif (args.check or args.latest) and s_new == s_old + 1:
-            # committed-pair modes tolerate exactly one adjacent bump: the PR
-            # that bumps the schema necessarily lands one cross-version pair
-            # in history forever, and refusing it would force
+        elif (args.check or args.latest) and s_new > s_old:
+            # committed-pair modes tolerate any FORWARD bump: the PR that
+            # bumps the schema necessarily lands one cross-version pair in
+            # history forever, and refusing it would force
             # --allow-schema-drift into the CI hook — disabling the fence
-            # exactly where it matters. Non-adjacent jumps still refuse.
+            # exactly where it matters. The span can exceed one step when a
+            # schema-bumping PR committed no BENCH artifact (v8 -> v10:
+            # PR 16 bumped to 9 without one), so the fence keys on
+            # direction, not adjacency. Backward jumps still refuse — a
+            # committed NEW older than OLD is never a release sequence.
+            span = "adjacent" if s_new == s_old + 1 else f"{s_new - s_old}-step"
             print(
-                f"bench_diff: warning: adjacent schema bump in committed "
-                f"pair ({s_old} -> {s_new}); fence relaxed for "
+                f"bench_diff: warning: {span} forward schema bump in "
+                f"committed pair ({s_old} -> {s_new}); fence relaxed for "
                 "--check/--latest",
                 file=sys.stderr,
             )

@@ -45,6 +45,16 @@ Presets (fault site x a transient kind, plus the failure-semantics checks):
                 per-batch isolation; the supervisor restart must lose no
                 request and reproduce the clean assignments
                 (serve_worker_restarts >= 1).
+  fleet_replica_death
+                serve_worker:raise_always planted mid-traffic against a
+                2-replica FleetRouter (ISSUE 18): every replica worker that
+                takes a request burns its restart budget and dies
+                (_fail_all), orphaning its accepted requests. The router
+                must re-route every orphan (failover + revival) with no
+                lost accepted request and bit-identical labels, the
+                _fail_all post-mortem must NAME the dead replica in its
+                detail, and ``tools/postmortem.py diff`` against a
+                routerless worker-death dump must exit 0.
   permanent     boot_chunk:raise_always — the NEGATIVE control: retries must
                 exhaust (fires == policy attempts) and the original
                 InjectedFault must surface, not be swallowed.
@@ -72,6 +82,7 @@ import os
 import shutil
 import sys
 import tempfile
+import time
 from typing import Dict, List, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -92,6 +103,7 @@ PRESETS: Dict[str, Tuple[Optional[str], str]] = {
     "serve_worker": ("serve_worker:raise_once", "serve"),
     "permanent": ("boot_chunk:raise_always", "permanent"),
     "postmortem": ("serve_worker:raise_always", "postmortem"),
+    "fleet_replica_death": ("serve_worker:raise_always", "fleet_death"),
 }
 
 
@@ -247,6 +259,80 @@ class ChaosHarness:
             else:
                 os.environ["CCTPU_POSTMORTEM_PATH"] = prev
         return surfaced, live
+
+    def fleet_death_run(self, pm_path: str, spec: str) -> dict:
+        """Plant a permanent worker fault mid-traffic against a 2-replica
+        fleet (ISSUE 18). Every replica worker that takes a request burns
+        its restart budget and _fail_all's, orphaning its accepted
+        requests; the router must failover/revive until the fault is
+        cleared, completing EVERY accepted request bit-identically.
+        Returns the verdict dict (fires, lost, round fingerprints,
+        failover/unhealthy counters, routed split)."""
+        import numpy as np
+
+        from consensusclustr_tpu.resilience.inject import (
+            clear_fault,
+            install_fault,
+        )
+        from consensusclustr_tpu.serve.fleet import build_fleet
+
+        art = self.artifact()
+        queries = [self.counts[:1], self.counts[1:4], self.counts[4:9]]
+        rounds = 5
+        prev = os.environ.get("CCTPU_POSTMORTEM_PATH")
+        os.environ["CCTPU_POSTMORTEM_PATH"] = pm_path
+        try:
+            with build_fleet(
+                art, 2, queue_depth=16, max_batch=16, buckets=(16,)
+            ) as fleet:
+                # warm traffic first: each worker must complete a batch and
+                # park in queue.get() so the fault (fired at the TOP of the
+                # worker loop) only lands once real requests are queued
+                for q in queries:
+                    fleet.assign(q, timeout=120)
+                inj = install_fault(spec)
+                futures = []
+                for _ in range(rounds):
+                    for q in queries:
+                        futures.append(fleet.submit(q))
+                # let the replicas die and the failover loop start churning
+                # before lifting the fault so revival can land
+                time.sleep(0.5)
+                clear_fault()
+                got, lost = [], 0
+                for f in futures:
+                    try:
+                        got.append(f.result(timeout=120).labels)
+                    except Exception:
+                        lost += 1
+                        got.append(None)
+                round_fps = []
+                if lost == 0:
+                    per_round = len(queries)
+                    for i in range(rounds):
+                        batch = got[i * per_round:(i + 1) * per_round]
+                        round_fps.append(labels_fp(np.concatenate(batch)))
+                reg = fleet.tracer.metrics
+                failovers = int(reg.counter("fleet_failovers").value)
+                unhealthy = int(
+                    reg.counter("fleet_replica_unhealthy").value
+                )
+                routed = fleet.routed_per_replica()
+        finally:
+            clear_fault()
+            if prev is None:
+                os.environ.pop("CCTPU_POSTMORTEM_PATH", None)
+            else:
+                os.environ["CCTPU_POSTMORTEM_PATH"] = prev
+        return {
+            "fires": inj.total_fires,
+            "lost": lost,
+            "accepted": len(futures),
+            "round_fps": round_fps,
+            "failovers": failovers,
+            "replica_unhealthy": unhealthy,
+            "routed": routed,
+        }
 
     # -- null statistics -----------------------------------------------------
 
@@ -498,6 +584,73 @@ def audit_preset(name: str, harness: ChaosHarness) -> dict:
                 and diff.returncode == 0
             )
             out["fires"] = fires_a
+
+        elif workload == "fleet_death":
+            # replica death under a 2-replica router (ISSUE 18): no
+            # accepted request may be lost, every re-routed answer must be
+            # bit-identical to the clean single-service run, the _fail_all
+            # post-mortem must NAME the dead replica, and the dump must
+            # diff cleanly against a routerless worker-death dump
+            import subprocess
+
+            if _HERE not in sys.path:
+                sys.path.insert(0, _HERE)
+            import postmortem as pm_tool
+
+            from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+
+            want = harness.clean_serve()
+            pm_fleet = os.path.join(harness.root, "pm_fleet.json")
+            pm_single = os.path.join(harness.root, "pm_single.json")
+            verdict = harness.fleet_death_run(pm_fleet, spec)
+            dump = pm_tool.load_dump(pm_fleet)
+            replica = str((dump.get("detail") or {}).get("replica") or "")
+            # dump B for the diff: the same fault against a bare
+            # AssignmentService (the `postmortem` preset's failure mode —
+            # its dump carries no replica name)
+            inj = install_fault(spec)
+            harness.serve_crash_run(pm_single)
+            clear_fault()
+            diff = subprocess.run(
+                [
+                    sys.executable, os.path.join(_HERE, "postmortem.py"),
+                    "diff", pm_fleet, pm_single,
+                ],
+                capture_output=True, text=True,
+            )
+            out.update(
+                recovered=True,
+                fingerprint_match=bool(
+                    verdict["round_fps"]
+                    and all(fp == want for fp in verdict["round_fps"])
+                ),
+                lost=verdict["lost"],
+                accepted=verdict["accepted"],
+                failovers=verdict["failovers"],
+                replica_unhealthy=verdict["replica_unhealthy"],
+                routed=verdict["routed"],
+                dump_schema=dump.get("schema"),
+                dead_replica=replica,
+                # the ring is shared across the fleet: router events
+                # (fleet_failover / fleet_replica_revived) flood the last
+                # few slots, so search the whole ring for the restart trail
+                tail_names_site=_tail_names_site(
+                    dump, "serve_worker", n=len(dump.get("events") or [])
+                ),
+                diff_rc=diff.returncode,
+            )
+            out["ok"] = (
+                verdict["fires"] >= 1
+                and verdict["lost"] == 0
+                and out["fingerprint_match"]
+                and (verdict["failovers"] >= 1
+                     or verdict["replica_unhealthy"] >= 1)
+                and dump.get("schema") == SCHEMA_VERSION
+                and replica.startswith("r")  # router-stamped replica name
+                and out["tail_names_site"]
+                and diff.returncode == 0
+            )
+            out["fires"] = verdict["fires"]
         else:  # pragma: no cover - registry and drivers move together
             raise AssertionError(f"unknown workload {workload!r}")
     except Exception as e:
@@ -552,6 +705,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     extra += f" (quarantined {res['resume_quarantined']})"
                 if res.get("worker_restarts"):
                     extra += f" (worker restarts {res['worker_restarts']})"
+                if res.get("dead_replica"):
+                    extra += (
+                        f" (failovers {res.get('failovers', 0)}, "
+                        f"post-mortem names {res['dead_replica']})"
+                    )
                 verdict = (
                     "recovered bit-identically"
                     if res.get("recovered")

@@ -16,8 +16,21 @@ queue growth, rejection rate, and tail. Backpressure rejections are counted,
 **not retried** (a retry would couple the arrival process to service state).
 Since ISSUE 10 each rejection carries the service's own ``retry_after_s``
 hint (queue depth over observed drain rate); the generator RECORDS the hints
-(``retry_after`` summary block: count seen / mean / max) but still never acts
-on them — the arrival process stays open-loop by design.
+(``retry_after`` summary block: count seen / mean / max) but by default never
+acts on them — the arrival process stays open-loop by design.
+
+Since ISSUE 18 two fleet-facing modes exist, both opt-in:
+
+  * ``--honor-retry-after`` closes the loop for REJECTED requests only: a
+    rejection sleeps its ``retry_after_s`` hint and resubmits (bounded
+    attempts). Accepted traffic still fires on the pre-drawn schedule; this
+    is the end-to-end exercise of the backpressure hints PR 10 left
+    recorded-but-unused, not a general closed loop. Default OFF — every
+    SLO number in BENCH_*.json stays open-loop.
+  * ``--target fleet`` drives a 2-replica (``--replicas``) FleetRouter
+    built by serve/fleet.py instead of a single AssignmentService — same
+    schedule, same parity checks (the router duck-types the service
+    surface), plus a ``routed`` per-replica split in the summary.
 
 Arrival processes (seeded, ``random.Random`` — reproducible):
 
@@ -214,6 +227,10 @@ def _query_pool(genes: int, mix, seed: int):
     return pool
 
 
+_RETRY_ATTEMPTS = 5          # --honor-retry-after resubmit budget
+_RETRY_DEFAULT_SLEEP_S = 0.01  # hintless-rejection backoff in that mode
+
+
 def run_open_loop(
     svc,
     offsets: Sequence[float],
@@ -221,11 +238,17 @@ def run_open_loop(
     genes: int,
     seed: int = 0,
     timeout: float = 120.0,
+    honor_retry_after: bool = False,
 ) -> dict:
     """Fire the schedule at ``svc``, wait for the stragglers, summarize.
 
-    Never retries a rejection (open loop); a request that would exceed
-    ``serve_max_batch`` is a configuration error and raises upfront.
+    By default never retries a rejection (open loop). With
+    ``honor_retry_after=True`` (ISSUE 18, opt-in) a rejected request sleeps
+    the service's ``retry_after_s`` hint and resubmits, up to
+    ``_RETRY_ATTEMPTS`` tries — only the rejected tail couples to service
+    state; accepted traffic still follows the pre-drawn schedule. A request
+    that would exceed ``serve_max_batch`` is a configuration error and
+    raises upfront.
     """
     from consensusclustr_tpu.serve.service import RetryableRejection
 
@@ -240,8 +263,9 @@ def run_open_loop(
     failures = [0]
     pending = []
     rejected = 0
-    retry_hints: List[float] = []  # retry_after_s per rejection (recorded,
-    #                                never acted on — open loop)
+    retries = 0                    # resubmits fired (honor_retry_after only)
+    retry_hints: List[float] = []  # retry_after_s per rejection (recorded;
+    #                                acted on only with honor_retry_after)
     max_lag = 0.0
     t0 = time.perf_counter()
     for off in offsets:
@@ -252,13 +276,25 @@ def run_open_loop(
             max_lag = max(max_lag, now - off)
         q = rnd.choice(pool[pick_size(mix, rnd)])
         t_sub = time.perf_counter()
-        try:
-            fut = svc.submit(q)
-        except RetryableRejection as e:
-            rejected += 1
-            hint = getattr(e, "retry_after_s", None)
-            if hint is not None:
-                retry_hints.append(float(hint))
+        fut = None
+        attempts = _RETRY_ATTEMPTS if honor_retry_after else 1
+        for attempt in range(attempts):
+            try:
+                fut = svc.submit(q)
+                break
+            except RetryableRejection as e:
+                rejected += 1
+                hint = getattr(e, "retry_after_s", None)
+                if hint is not None:
+                    retry_hints.append(float(hint))
+                if not honor_retry_after or attempt == attempts - 1:
+                    break
+                retries += 1
+                time.sleep(
+                    float(hint) if hint is not None
+                    else _RETRY_DEFAULT_SLEEP_S
+                )
+        if fut is None:
             continue
 
         def _done(f, t_sub=t_sub):
@@ -277,7 +313,16 @@ def run_open_loop(
     submit_window = time.perf_counter() - t0
     deadline = time.monotonic() + timeout
     for fut in pending:
-        fut.result(timeout=max(deadline - time.monotonic(), 0.001))
+        try:
+            fut.result(timeout=max(deadline - time.monotonic(), 0.001))
+        except Exception:
+            # a FAILED future was already counted by its done-callback; a
+            # straggler past the drain deadline never ran the callback, so
+            # count it here — either way the summary records it, the run
+            # itself never crashes (the artifact must show failed=0, not
+            # vanish)
+            if not fut.done():
+                failures[0] += 1
     wall = time.perf_counter() - t0
 
     submitted = len(offsets)
@@ -297,14 +342,17 @@ def run_open_loop(
         if submit_window > 0 else 0.0,
         "goodput_rps": round(completed / wall, 2) if wall > 0 else 0.0,
         "rejection_rate": round(rejected / submitted, 4) if submitted else 0.0,
-        # the service's backpressure hints, recorded only (ISSUE 10): how
-        # often a rejection carried retry_after_s and what it advised
+        # the service's backpressure hints (ISSUE 10): how often a rejection
+        # carried retry_after_s and what it advised; acted on only in the
+        # opt-in honor_retry_after mode (ISSUE 18)
         "retry_after": {
             "hinted": len(retry_hints),
             "mean_s": round(sum(retry_hints) / len(retry_hints), 4)
             if retry_hints else None,
             "max_s": round(max(retry_hints), 4) if retry_hints else None,
         },
+        "honor_retry_after": bool(honor_retry_after),
+        "retries": retries,
         **_quantiles_ms(lat),
         "phase_parity": phase_parity(timings),
         "metrics_parity": metrics_parity(svc, lat),
@@ -395,6 +443,28 @@ def step_alerts(svc) -> Optional[dict]:
     }
 
 
+def _build_target(
+    artifact, target: str, queue_depth: int, max_batch: int, replicas: int
+):
+    """One ladder step's service: a single AssignmentService (the PR 7
+    contract) or a FleetRouter over ``replicas`` of them (ISSUE 18 — the
+    router duck-types the service surface, so everything downstream is
+    shared)."""
+    if target == "fleet":
+        from consensusclustr_tpu.serve.fleet import build_fleet
+
+        return build_fleet(
+            artifact, replicas, max_batch=max_batch, queue_depth=queue_depth,
+        )
+    if target == "service":
+        from consensusclustr_tpu.serve.service import AssignmentService
+
+        return AssignmentService(
+            artifact, max_batch=max_batch, queue_depth=queue_depth,
+        )
+    raise ValueError(f"unknown --target {target!r}")
+
+
 def slo_ladder(
     artifact,
     rates: Sequence[float],
@@ -407,14 +477,16 @@ def slo_ladder(
     queue_depth: int = 16,
     max_batch: int = 64,
     timeout: float = 120.0,
+    target: str = "service",
+    replicas: int = 2,
+    honor_retry_after: bool = False,
 ) -> dict:
     """One open-loop run per offered rate, fresh service each step (clean
     histograms; jit caches persist process-wide so only step 1 pays warmup).
     Every step emits goodput + rejection rate + p50/p99/p999 — including
     saturated steps; the failure shape of a step is an ``error`` key, never
-    a missing step."""
-    from consensusclustr_tpu.serve.service import AssignmentService
-
+    a missing step. ``target="fleet"`` runs each step against a
+    ``replicas``-wide FleetRouter and adds the routed-per-replica split."""
     steps = []
     for i, rate in enumerate(rates):
         step = {"target_rps": round(float(rate), 2)}
@@ -423,13 +495,13 @@ def slo_ladder(
                 rate, process=process, sigma=sigma, seed=seed + i,
                 duration=duration,
             )
-            with AssignmentService(
-                artifact, max_batch=max_batch, queue_depth=queue_depth,
+            with _build_target(
+                artifact, target, queue_depth, max_batch, replicas
             ) as svc:
                 step.update(
                     run_open_loop(
                         svc, offsets, mix, genes, seed=seed + i,
-                        timeout=timeout,
+                        timeout=timeout, honor_retry_after=honor_retry_after,
                     )
                 )
                 # alert firings per offered-rate step (ISSUE 14): the
@@ -439,10 +511,14 @@ def slo_ladder(
                 alerts = step_alerts(svc)
                 if alerts is not None:
                     step["alerts"] = alerts
+                routed = getattr(svc, "routed_per_replica", None)
+                if routed is not None:
+                    step["routed"] = routed()
         except Exception as e:  # the rung must emit every step
             step["error"] = str(e)[:200]
         steps.append(step)
-    return {"steps": steps, "duration_s": duration, "process": process}
+    return {"steps": steps, "duration_s": duration, "process": process,
+            "target": target}
 
 
 # -- CLI ----------------------------------------------------------------------
@@ -472,6 +548,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--queue-depth", type=int, default=16)
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="straggler wait after the schedule ends")
+    ap.add_argument("--target", choices=("service", "fleet"),
+                    default="service",
+                    help="drive a single AssignmentService (default) or a "
+                         "FleetRouter over --replicas of them (ISSUE 18)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet width for --target fleet (default 2)")
+    ap.add_argument("--honor-retry-after", action="store_true",
+                    help="opt-in: sleep a rejection's retry_after_s hint "
+                         "and resubmit (bounded); default keeps the strict "
+                         "open loop — rejections are counted, not retried")
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="export the service trace (flow-linked, "
                          "ui.perfetto.dev) and report the link count")
@@ -488,8 +574,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     mix = parse_sizes(args.sizes)
 
-    from consensusclustr_tpu.serve.service import AssignmentService
-
     art, _ = synthetic_artifact(args.ref_cells, args.genes, seed=args.seed)
 
     if args.ladder:
@@ -498,7 +582,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             art, rates, duration or 3.0, args.genes, mix, seed=args.seed,
             process=args.process, sigma=args.sigma,
             queue_depth=args.queue_depth, max_batch=args.max_batch,
-            timeout=args.timeout,
+            timeout=args.timeout, target=args.target,
+            replicas=args.replicas,
+            honor_retry_after=args.honor_retry_after,
         )
         summary["mode"] = "ladder"
     else:
@@ -506,15 +592,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.rate, process=args.process, sigma=args.sigma,
             seed=args.seed, duration=duration, count=args.requests,
         )
-        with AssignmentService(
-            art, max_batch=args.max_batch, queue_depth=args.queue_depth,
+        with _build_target(
+            art, args.target, args.queue_depth, args.max_batch,
+            args.replicas,
         ) as svc:
             summary = run_open_loop(
                 svc, offsets, mix, args.genes, seed=args.seed,
                 timeout=args.timeout,
+                honor_retry_after=args.honor_retry_after,
             )
             summary["mode"] = "open_loop"
             summary["target_rps"] = args.rate
+            routed = getattr(svc, "routed_per_replica", None)
+            if routed is not None:
+                summary["routed"] = routed()
             rec = svc.run_record()
         if args.record:
             rec.write(args.record)
@@ -534,6 +625,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     summary["process"] = args.process
     summary["seed"] = args.seed
     summary["sizes"] = args.sizes
+    summary["target"] = args.target
 
     if args.json:
         print(json.dumps(summary))

@@ -31,7 +31,11 @@ named as the comparability fence it is. Schema v9 payloads additionally
 carry per-program attribution (``program_profile``): when the aggregate
 bytes stayed flat (within 2%) but an individual program's bytes grew
 >5%, the row is annotated as a SILENT SHIFT — work migrated between
-programs without moving the global counter (ISSUE 16).
+programs without moving the global counter (ISSUE 16). Schema v10
+payloads additionally carry the fleet rung (``fleet_p99_ms`` /
+``fleet_rejection_rate`` / ``fleet_swap_compiles``, ISSUE 18) — surfaced
+in the --json rows; cross-schema gating needs no special case because
+the v9->v10 bump rides the same-schema fence like every bump before it.
 
 --check is the gate: exit 3 when any ADJACENT same-schema pair's ledger
 regressed (a counter grew), naming the pair and the counter. Cross-schema
@@ -147,6 +151,15 @@ def program_bytes_of(payload: dict) -> Optional[dict]:
                 out[str(row["name"])] = float(row.get("est_bytes", 0))
             except (TypeError, ValueError):
                 continue
+    return out or None
+
+
+def fleet_of(payload: dict) -> Optional[dict]:
+    """The fleet rung's top-level keys (schema v10+, ISSUE 18), or None
+    when the round predates the fleet layer (or its rung failed and only
+    the zero shape landed — an empty-steps rung still carries the keys)."""
+    keys = ("fleet_p99_ms", "fleet_rejection_rate", "fleet_swap_compiles")
+    out = {k: payload[k] for k in keys if k in payload}
     return out or None
 
 
@@ -360,6 +373,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "program_bytes": (
                     program_bytes_of(r["payload"]) if r["payload"] else None
                 ),
+                "fleet": fleet_of(r["payload"]) if r["payload"] else None,
             }
             for r in rows
         ]

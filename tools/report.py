@@ -35,7 +35,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -577,6 +577,45 @@ def alerts(record: dict) -> str:
     return "\n".join(lines)
 
 
+def fleet(record: dict) -> str:
+    """Fleet-router table (obs schema >= 10): the multi-replica admission
+    counters a FleetRouter.run_record carries — routed/rejected/failover
+    totals, replica count, hot-swap count with its compile delta (0 is the
+    zero-downtime pin), and adaptive-control activity. Records from a
+    single service (or older schemas) render the placeholder line —
+    absence is normal, never an error (same contract as the serving
+    table)."""
+    m = record.get("metrics") or {}
+    counters = m.get("counters") or {}
+    gauges = m.get("gauges") or {}
+    if not any(str(k).startswith("fleet_") for k in counters) and not any(
+        str(k).startswith("fleet_") for k in gauges
+    ):
+        return "(no fleet activity)"
+    lines: List[str] = []
+    if gauges.get("fleet_replicas") is not None:
+        lines.append(f"{'replicas':<28} {gauges['fleet_replicas']:g}")
+    for label, key in (
+        ("requests routed", "fleet_requests_routed"),
+        ("fleet-wide rejections", "fleet_rejections"),
+        ("failovers", "fleet_failovers"),
+        ("unhealthy skips", "fleet_replica_unhealthy"),
+        ("hot swaps", "fleet_swaps"),
+        ("swap-time compiles", "fleet_swap_compiles"),
+        ("control sheds", "fleet_control_sheds"),
+        ("control decisions", "fleet_control_decisions"),
+    ):
+        if key in counters:
+            lines.append(f"{label:<28} {counters[key]:g}")
+    routed = counters.get("fleet_requests_routed")
+    rej = counters.get("fleet_rejections")
+    if routed is not None and rej:
+        offered = routed + rej
+        if offered:
+            lines.append(f"{'rejection rate':<28} {rej / offered:.4f}")
+    return "\n".join(lines)
+
+
 def metrics_summary(record: dict) -> str:
     m = record.get("metrics") or {}
     lines: List[str] = []
@@ -628,6 +667,7 @@ def render(record: dict) -> str:
         "", "== span tree ==", flame(record),
         "", "== pipelining ==", pipelining(record),
         "", "== serving ==", serving(record),
+        "", "== fleet ==", fleet(record),
         "", "== consensus ==", consensus(record),
         "", "== dispatch ==", dispatch(record),
         "", "== work ==", work(record),

@@ -495,6 +495,7 @@ _FLEET_SLO_ZERO = {
     "fleet_rejection_rate": 0.0,
     "fleet_routed": {},
     "fleet_swap_compiles": 0,
+    "fleet_trace": {},
 }
 
 # The warm-start rung's zero shape (ISSUE 13) — emitted verbatim on the
@@ -834,6 +835,9 @@ def _fleet_swap_pin(lg, art, rate, duration, genes, mix) -> dict:
         report = fleet.swap_reference(art2)
         th.join(timeout=120.0)
         routed = fleet.routed_per_replica()
+        # merged trace accounting (ISSUE 19): captured while the drained
+        # generation's services are still open so their lanes survive
+        fleet_trace = fleet.fleet_record().summary()
     return {
         "rate_rps": round(float(rate), 2),
         "swap_compiles": int(report["swap_compiles"]),
@@ -843,6 +847,7 @@ def _fleet_swap_pin(lg, art, rate, duration, genes, mix) -> dict:
         "rejected": run.get("rejected"),
         "failed": run.get("failed"),
         "routed": routed,
+        "fleet_trace": fleet_trace,
     }
 
 
@@ -907,6 +912,7 @@ def _fleet_slo_rung(rates=None) -> dict:
         out["fleet_swap_compiles"] = int(
             ladder["swap"].get("swap_compiles") or 0
         )
+        out["fleet_trace"] = dict(ladder["swap"].get("fleet_trace") or {})
         return out
     except Exception as e:
         out = {k: (dict(v) if isinstance(v, dict) else v)

@@ -77,6 +77,17 @@ stacks prefixed with each thread's open-span path
 (``RunRecord.profile``), exported as collapsed-stack text or speedscope
 JSON by ``tools/flamegraph.py``, and ridden into ``postmortem.json`` by
 the flight recorder when armed.
+
+The fleet-tracing layer (ISSUE 19 tentpole, ``obs/fleetobs.py``) merges the
+per-process fragments a multi-replica fleet scatters: ``FleetRecord`` (new
+artifact kind ``"fleet_record"``, schema v11) embeds the router's and every
+replica's RunRecord — retired generations included — each with its tracer's
+epoch offset, plus the router's retained per-request hop chains
+(``trace_id`` minted at admission, hops appended at every route / failover /
+revival). ``obs/export.py::write_fleet_chrome_trace`` renders it as one
+Perfetto trace with per-replica process lanes, cross-replica flow links and
+fleet counter tracks; ``tools/timeline.py`` folds it into the causal
+incident timeline.
 """
 
 from consensusclustr_tpu.obs.alerts import (
@@ -100,6 +111,11 @@ from consensusclustr_tpu.obs.export import (
     chrome_trace_events,
     prom_text_from_snapshot,
     write_chrome_trace,
+    write_fleet_chrome_trace,
+)
+from consensusclustr_tpu.obs.fleetobs import (
+    FLEET_RECORD_KIND,
+    FleetRecord,
 )
 from consensusclustr_tpu.obs.fingerprint import (
     NumericsMonitor,
@@ -159,6 +175,8 @@ __all__ = [
     "AlertRule",
     "DEFAULT_BOUNDS",
     "EVENT_KINDS",
+    "FLEET_RECORD_KIND",
+    "FleetRecord",
     "FlightRecorder",
     "Histogram",
     "LEDGER_COUNTERS",
@@ -204,4 +222,5 @@ __all__ = [
     "start_profiler_for",
     "tracer_of",
     "write_chrome_trace",
+    "write_fleet_chrome_trace",
 ]

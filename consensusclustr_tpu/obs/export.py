@@ -19,6 +19,13 @@ Two operator-facing serializations of the obs/ state (ISSUE 4 tentpole):
     ``# TYPE`` headers, counters as ``_total``, histograms as cumulative
     ``_bucket{le="..."}`` series plus ``_sum``/``_count``. This is what the
     ``AssignmentService`` ``/metrics`` endpoint serves.
+  * :func:`fleet_chrome_trace` (ISSUE 19) — a serialized FleetRecord
+    (obs/fleetobs.py) as ONE merged trace: each embedded RunRecord rendered
+    through :func:`chrome_trace_events` then rebased by its epoch offset onto
+    its own process lane (router = pid 1, replicas 2+, retired lanes kept),
+    cross-replica ``ph:"s"/"t"/"f"`` flow links along every multi-hop request
+    chain (failover re-routes, revival hand-offs), and fleet gauges as
+    counter tracks replayed from the router's event stream.
 
 Everything here operates on plain JSON-shaped dicts and stdlib types — no
 jax, no numpy — so ``tools/report.py`` can load this file directly (by path,
@@ -298,6 +305,218 @@ def write_chrome_trace(
             ),
             f,
         )
+    return path
+
+
+# -- fleet merge (ISSUE 19): one trace across router + every replica ---------
+
+FLEET_FLOW_NAME = "fleet_trace"
+FLEET_HOP_LANE = "fleet_hops"
+FLEET_HOP_TID = 99
+FLEET_ROUTER_PROCESS = "fleet_router"
+
+
+def _shift_record_events(
+    record: dict, pid: int, process_name: str, shift_us: int
+) -> List[dict]:
+    """One embedded RunRecord's :func:`chrome_trace_events`, rebased onto the
+    fleet clock: ``pid`` reassigned to this lane, every non-metadata timestamp
+    shifted by the record's epoch offset, and ``cat:"serve"`` flow ids
+    namespaced by pid — per-replica ``req_id`` counters all start at 1, and
+    colliding flow ids would let Perfetto draw arrows between unrelated
+    requests on different lanes."""
+    out: List[dict] = []
+    for e in chrome_trace_events(
+        record.get("spans") or (),
+        record.get("events") or (),
+        resource=record.get("resource"),
+        numerics=record.get("numerics"),
+    ):
+        e = dict(e)
+        e["pid"] = pid
+        if e.get("ph") == "M":
+            if e.get("name") == "process_name":
+                e["args"] = {"name": process_name}
+        else:
+            e["ts"] = int(e.get("ts", 0)) + shift_us
+            if e.get("cat") == "serve" and "id" in e:
+                e["id"] = pid * 1_000_000 + int(e["id"])
+        out.append(e)
+    return out
+
+
+def fleet_flow_events(
+    fleet: dict, pid_of: Dict[str, int], shift_us: int = 0
+) -> List[dict]:
+    """Cross-replica flow links for every retained multi-hop chain.
+
+    Each hop renders as a mini ``ph:"X"`` slice on a dedicated ``fleet_hops``
+    lane (fixed ``tid`` 99) of the replica it landed on, spanning from the
+    hop's admission-relative route time to the next hop (or, for the final
+    hop, its replica-measured serve latency). The slices are chained with a
+    Perfetto multi-step flow — ``ph:"s"`` at the first hop, ``ph:"t"`` at
+    intermediate hops, ``ph:"f"``/``bp:"e"`` at the last — sharing
+    ``cat:"fleet"`` and ``id`` = the fleet-scoped trace id, so a failover
+    re-route or revival hand-off draws as one arrow sequence hopping across
+    process lanes. Single-hop chains are skipped: those requests already
+    render via the per-replica ``serve`` flow pairs."""
+    out: List[dict] = []
+    named: set = set()
+    for tr in (fleet.get("trace") or {}).get("traces") or ():
+        hops = tr.get("hops") or ()
+        if len(hops) < 2:
+            continue
+        try:
+            t_admit = float(tr.get("t_admit") or 0.0)
+            flow_id = int(tr["trace_id"])
+        except (TypeError, ValueError, KeyError):
+            continue
+        chain = []
+        for k, hop in enumerate(hops):
+            pid = pid_of.get(str(hop.get("replica")))
+            if pid is None:
+                continue
+            t = float(hop.get("t") or 0.0)
+            if k + 1 < len(hops):
+                dur_s = max(float(hops[k + 1].get("t") or 0.0) - t, 0.0)
+            else:
+                dur_s = max(float(hop.get("serve_latency_s") or 0.0), 0.0)
+            chain.append((pid, _us(t_admit + t) + shift_us, max(_us(dur_s), 1), hop))
+        if len(chain) < 2:
+            continue
+        for k, (pid, ts, dur, hop) in enumerate(chain):
+            if pid not in named:
+                named.add(pid)
+                out.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": FLEET_HOP_TID, "args": {"name": FLEET_HOP_LANE},
+                })
+            args = {
+                k2: hop[k2]
+                for k2 in ("trace_id", "hop", "replica", "kind", "req_id",
+                           "outcome", "error", "serve_latency_s")
+                if hop.get(k2) is not None
+            }
+            base = {
+                "name": FLEET_FLOW_NAME, "cat": "fleet", "pid": pid,
+                "tid": FLEET_HOP_TID,
+            }
+            out.append({**base, "ph": "X", "ts": ts, "dur": dur, "args": args})
+            ph = "s" if k == 0 else ("f" if k == len(chain) - 1 else "t")
+            flow = {**base, "ph": ph, "id": flow_id, "ts": ts}
+            if ph == "f":
+                flow["bp"] = "e"
+            out.append(flow)
+    return out
+
+
+def fleet_counter_events(router_rec: dict, shift_us: int = 0) -> List[dict]:
+    """Fleet gauges as ``ph:"C"`` counter tracks on the router lane, replayed
+    from the router's event stream: configured fleet size (``fleet_start``
+    name-list / ``fleet_swap`` count), a healthy-replica track that dips on
+    ``fleet_replica_down`` and recovers on ``fleet_replica_revived``, and a
+    cumulative failover count stepping at each ``fleet_failover``."""
+    out: List[dict] = []
+    size: Optional[int] = None
+    healthy: Optional[int] = None
+    failovers = 0
+
+    def emit(ts: int, name: str, value: int) -> None:
+        out.append({
+            "name": name, "cat": "fleet", "ph": "C", "ts": ts, "pid": 1,
+            "args": {name.rsplit("_", 1)[-1]: value},
+        })
+
+    for ev in router_rec.get("events") or ():
+        kind = ev.get("kind")
+        try:
+            ts = _us(float(ev.get("t") or 0.0)) + shift_us
+        except (TypeError, ValueError):
+            continue
+        if kind == "fleet_start":
+            size = healthy = len(ev.get("replicas") or ())
+        elif kind == "fleet_swap":
+            try:
+                size = int(ev.get("replicas") or 0) or size
+            except (TypeError, ValueError):
+                pass
+            healthy = size
+        elif kind == "fleet_replica_down":
+            healthy = max((healthy if healthy is not None else 1) - 1, 0)
+        elif kind == "fleet_replica_revived":
+            healthy = min(
+                (healthy if healthy is not None else 0) + 1,
+                size if size is not None else 1 << 30,
+            )
+        elif kind == "fleet_failover":
+            failovers += 1
+            emit(ts, "fleet_failovers", failovers)
+            continue
+        else:
+            continue
+        if size is not None:
+            emit(ts, "fleet_replicas", size)
+        if healthy is not None:
+            emit(ts, "fleet_healthy_replicas", healthy)
+    return out
+
+
+def fleet_trace_events(fleet: dict) -> List[dict]:
+    """Merged trace-event list for a serialized FleetRecord dict.
+
+    Process lanes: the router is ``pid`` 1 (``fleet_router``); replicas get
+    ``pid`` 2+ in record order, retired lanes labeled ``(retired)`` so a
+    revival's dead predecessor and a swap's drained generation stay visible
+    next to their successors. All timestamps rebase onto the earliest epoch
+    in the fleet (replicas are built before the router in ``build_fleet``,
+    so the *minimum* offset — possibly negative — anchors ts 0; Perfetto
+    clamps negative timestamps). On top of the per-record lanes:
+    :func:`fleet_flow_events` (cross-replica hop chains) and
+    :func:`fleet_counter_events` (fleet gauges)."""
+    router_rec = fleet.get("router") or {}
+    replicas = list(fleet.get("replicas") or ())
+    base = min(
+        [0.0] + [float(r.get("epoch_offset_s") or 0.0) for r in replicas]
+    )
+    router_shift = _us(0.0 - base)
+    out = _shift_record_events(router_rec, 1, FLEET_ROUTER_PROCESS, router_shift)
+    pid_of: Dict[str, int] = {}
+    for i, rep in enumerate(replicas):
+        pid = 2 + i
+        name = str(rep.get("name") or f"replica{i}")
+        pid_of.setdefault(name, pid)
+        label = f"replica:{name}" + (" (retired)" if rep.get("retired") else "")
+        out.extend(_shift_record_events(
+            rep.get("record") or {}, pid, label,
+            _us(float(rep.get("epoch_offset_s") or 0.0) - base),
+        ))
+    # hop timestamps are admission-relative on the *router* clock
+    out.extend(fleet_flow_events(fleet, pid_of, router_shift))
+    out.extend(fleet_counter_events(router_rec, router_shift))
+    return out
+
+
+def fleet_chrome_trace(fleet: dict, metadata: Optional[dict] = None) -> dict:
+    """The full trace-object form for a FleetRecord dict."""
+    doc = {
+        "traceEvents": fleet_trace_events(fleet),
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "fleet_schema": fleet.get("schema"),
+            "generation": fleet.get("generation"),
+            "replicas": len(fleet.get("replicas") or ()),
+            **(metadata or {}),
+        },
+    }
+    return doc
+
+
+def write_fleet_chrome_trace(
+    path: str, fleet: dict, metadata: Optional[dict] = None
+) -> str:
+    """Serialize :func:`fleet_chrome_trace` to ``path``; returns the path."""
+    with open(path, "w") as f:
+        json.dump(fleet_chrome_trace(fleet, metadata=metadata), f)
     return path
 
 

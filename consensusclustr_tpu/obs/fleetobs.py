@@ -1,0 +1,168 @@
+"""Fleet-wide observability aggregation (ISSUE 19 tentpole, part b).
+
+Every observability surface built in PRs 1–16 — tracer, run records,
+Perfetto export, flight recorder, alerts — is strictly per-process: each
+``AssignmentService`` replica and the ``FleetRouter`` itself owns a private
+:class:`~consensusclustr_tpu.obs.tracer.Tracer` with its own epoch, its own
+metric registry, and its own event stream. A request that is admitted by
+the router, orphaned by a replica death and re-routed to a revival slot
+therefore leaves *three unlinked fragments in three separate tracers*.
+
+:class:`FleetRecord` is the merge: the router's RunRecord, every replica's
+RunRecord — **including retired replicas** (revival-replaced or
+swap-drained; the router keeps them precisely so their lanes stay
+renderable), each stamped with its tracer's epoch offset from the router's
+(``Tracer.epoch_offset_from``), so all timestamps rebase onto one shared
+timeline — plus the router's retained hop-chain table (the fleet-scoped
+``trace_id`` → ordered hops the router records per admission).
+
+Consumers:
+
+  * ``obs/export.py::fleet_chrome_trace`` — one Perfetto trace, one process
+    lane per replica (the router gets its own), cross-replica
+    ``ph:"s"/"t"/"f"`` flow links along each multi-hop chain, fleet gauges
+    as counter tracks;
+  * ``tools/timeline.py`` — the causally ordered incident timeline
+    (stdlib-only: it folds the serialized dict, never this module);
+  * ``tools/report.py`` / ``tools/chaos_audit.py`` / ``tools/loadgen.py`` —
+    the reviewable incident artifact each fleet run can emit
+    (``CCTPU_FLEET_TRACE_PATH``).
+
+The FleetRecord is a NEW artifact kind (``"fleet_record"``) that *embeds*
+RunRecords — the RunRecord layout itself is unchanged at schema v11.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from consensusclustr_tpu.obs.record import RunRecord
+from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
+
+FLEET_RECORD_KIND = "fleet_record"
+
+
+@dataclass
+class FleetRecord:
+    """One merged, schema-versioned snapshot of a whole fleet's telemetry.
+
+    ``replicas`` entries are ``{"name", "retired", "epoch_offset_s",
+    "record"}`` — ``epoch_offset_s`` is the replica tracer's birth relative
+    to the router tracer's (positive = born later), the rebase every
+    consumer applies to put all lanes on the router's clock. ``trace`` is
+    the router's hop-chain table (``FleetRouter.trace_table()``).
+    """
+
+    schema: int = SCHEMA_VERSION
+    generation: int = 0
+    router: dict = field(default_factory=dict)
+    replicas: List[dict] = field(default_factory=list)
+    trace: dict = field(default_factory=dict)
+    routed: Dict[str, int] = field(default_factory=dict)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_router(cls, router, config=None) -> "FleetRecord":
+        """Snapshot a live :class:`~consensusclustr_tpu.serve.router.
+        FleetRouter`: its own record, every replica it ever owned (current
+        rotation first, then retired slots), and the retained hop chains.
+        Callable mid-run or post-close — tracers outlive their services."""
+        from consensusclustr_tpu.utils.backend import default_backend
+
+        backend = default_backend()
+        router_rec = RunRecord.from_tracer(
+            router.tracer, config=config, backend=backend,
+            include_global_metrics=False,
+        )
+        replicas = []
+        for name, svc, retired in router.replica_records():
+            rec = RunRecord.from_tracer(
+                svc.tracer, config=None, backend=backend,
+                include_global_metrics=False,
+            )
+            replicas.append({
+                "name": str(name),
+                "retired": bool(retired),
+                "epoch_offset_s": svc.tracer.epoch_offset_from(router.tracer),
+                "record": rec.to_dict(),
+            })
+        return cls(
+            schema=SCHEMA_VERSION,
+            generation=int(router.generation),
+            router=router_rec.to_dict(),
+            replicas=replicas,
+            trace=router.trace_table(),
+            routed=dict(router.routed_per_replica()),
+        )
+
+    # -- (de)serialization ---------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": FLEET_RECORD_KIND,
+            "schema": self.schema,
+            "generation": self.generation,
+            "router": self.router,
+            "replicas": self.replicas,
+            "trace": self.trace,
+            "routed": self.routed,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def write(self, path: str) -> str:
+        """One whole-fleet JSON document (NOT JSONL — a FleetRecord is one
+        incident artifact, not an append-stream of runs)."""
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f)
+        return path
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetRecord":
+        return cls(
+            schema=int(d.get("schema") or 0),
+            generation=int(d.get("generation") or 0),
+            router=dict(d.get("router") or {}),
+            replicas=list(d.get("replicas") or []),
+            trace=dict(d.get("trace") or {}),
+            routed=dict(d.get("routed") or {}),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "FleetRecord":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    # -- rendering -----------------------------------------------------------
+
+    def to_chrome_trace(self, path: str, metadata: Optional[dict] = None) -> str:
+        """The merged Perfetto trace (ui.perfetto.dev): router + replica
+        process lanes, cross-replica flow links, fleet counter tracks."""
+        from consensusclustr_tpu.obs.export import write_fleet_chrome_trace
+
+        return write_fleet_chrome_trace(path, self.to_dict(), metadata=metadata)
+
+    def multi_hop_traces(self) -> List[dict]:
+        """The re-routed requests: retained hop chains with >= 2 hops (the
+        ones the fleet export draws cross-replica flow links for)."""
+        return [
+            tr for tr in (self.trace.get("traces") or ())
+            if len(tr.get("hops") or ()) >= 2
+        ]
+
+    def summary(self) -> dict:
+        """The compact block bench/loadgen payloads embed as
+        ``fleet_trace``: chain retention plus the multi-hop (re-route)
+        count — enough for tools/perf_history.py to trend."""
+        traces = self.trace.get("traces") or ()
+        return {
+            "replicas": len(self.replicas),
+            "retired": sum(1 for r in self.replicas if r.get("retired")),
+            "traces": len(traces),
+            "multi_hop": len(self.multi_hop_traces()),
+            "dropped": int(self.trace.get("dropped") or 0),
+        }

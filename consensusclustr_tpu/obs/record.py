@@ -8,6 +8,11 @@ object per line (JSONL) so long-lived processes append records and
 
 Kept deliberately jax-free at import time: report tooling and post-hoc
 analysis load records without touching a backend.
+
+Schema v11 (ISSUE 19) added no RunRecord fields: fleet-wide tracing lives
+in a NEW artifact kind (obs/fleetobs.py ``FleetRecord``) that embeds one
+RunRecord per fleet lane *unchanged* — this module stays the single
+serializer for both.
 """
 
 from __future__ import annotations

@@ -128,7 +128,27 @@ from __future__ import annotations
 # the bump marks the payload keys and the name vocabulary so bench_diff
 # treats v9/v10 artifacts as schema-incomparable. See docs/quirks.md
 # "Observability schema v9 → v10".
-SCHEMA_VERSION = 10
+# v11 (ISSUE 19): fleet-wide distributed tracing — the FleetRouter mints a
+# fleet-scoped ``trace_id`` at admission (router-minted, NOT replica-minted:
+# a replica can die before it would mint anything, and only the router sees
+# every hop of a request that crosses replicas) and records an ordered hop
+# chain (initial route / failover re-route / revival slot) per request,
+# threaded through ``AssignmentService.submit`` → the ``serve_request``
+# event → the ``serve_batch`` span → ``AssignResult.timing["trace"]``.
+# obs/fleetobs.py merges the router's and every replica's (live AND
+# retired) RunRecords into one ``FleetRecord`` whose Perfetto export
+# (obs/export.py fleet_* functions) gives each replica its own process
+# lane, draws cross-replica ``ph:"s"/"t"/"f"`` flow links along each
+# multi-hop chain, and renders fleet gauges as counter tracks;
+# tools/timeline.py folds the merged events into a causally ordered
+# incident timeline (render/diff, bench_diff exit codes). New names: the
+# ``fleet_traces_dropped`` counter and the ``CCTPU_FLEET_TRACE_*`` knobs
+# (hop-chain retention cap + the incident-artifact path loadgen and
+# chaos_audit write). The RunRecord layout is unchanged; the FleetRecord is
+# a NEW artifact kind ("fleet_record") that embeds RunRecords. Bench
+# payloads gain the top-level ``fleet_trace`` block (zero shape ``{}`` on
+# failure). See docs/quirks.md "Observability schema v10 → v11".
+SCHEMA_VERSION = 11
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
 # stream (the original LevelLog contract, SURVEY §5).
@@ -323,6 +343,10 @@ METRIC_HELP = {
     "fleet_swap_compiles": "counter: fresh executable compiles during swap windows (pinned 0 when the AOT cache is warm)",
     "fleet_control_sheds": "counter: requests shed at the router door by an armed ControlPolicy under burn pressure",
     "fleet_control_decisions": "counter: ControlPolicy pressure-class transitions applied to a replica",
+    # fleet-wide distributed tracing (ISSUE 19): hop chains are retained per
+    # trace_id up to CCTPU_FLEET_TRACE_CAP; admissions past the cap still
+    # serve (and still carry a trace_id) but record no chain
+    "fleet_traces_dropped": "counter: admitted requests whose hop chain was not retained (past CCTPU_FLEET_TRACE_CAP)",
 }
 
 # Metrics registry names (counters, gauges, histograms).
@@ -565,6 +589,14 @@ ENV_KNOBS = {
     "CCTPU_FLEET_REPLICAS": (
         "2",
         "Default FleetRouter replica count (build_fleet).",
+    ),
+    "CCTPU_FLEET_TRACE_CAP": (
+        "100000",
+        "Fleet hop-chain retention cap (trace_ids past it count fleet_traces_dropped).",
+    ),
+    "CCTPU_FLEET_TRACE_PATH": (
+        "unset",
+        "When set, fleet loadgen/chaos runs write the merged FleetRecord incident artifact here.",
     ),
     "CCTPU_FORCE_CPU": (
         "unset",

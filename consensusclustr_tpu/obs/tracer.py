@@ -237,6 +237,15 @@ class Tracer:
     def elapsed(self) -> float:
         return round(time.monotonic() - self.epoch, 4)
 
+    def epoch_offset_from(self, other: "Tracer") -> float:
+        """Seconds between this tracer's epoch and ``other``'s (positive
+        when this tracer was born later). Span ``t0``/event ``t`` stamps
+        are epoch-relative, so adding this offset rebases them onto
+        ``other``'s timeline — the fleet-merge primitive (obs/fleetobs.py):
+        every replica's record shifts onto the router's clock so one merged
+        trace orders events across processes-worth of tracers."""
+        return round(self.epoch - other.epoch, 6)
+
     @staticmethod
     def _emit(rec: dict) -> None:
         import json

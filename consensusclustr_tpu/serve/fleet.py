@@ -17,8 +17,14 @@ Quick start (also in README)::
     try:
         labels = fleet.assign(counts).labels
         fleet.swap_reference(artifact_v2)     # zero-downtime version swap
+        record = fleet.fleet_record()         # merged fleet trace (ISSUE 19)
     finally:
         fleet.close()
+
+Every admitted request carries a router-minted ``trace_id`` whose hop chain
+(initial route, failover re-route, revival) lands in ``fleet_record()`` —
+the schema-v11 merged artifact obs/fleetobs.py serializes and
+tools/timeline.py folds into a causal incident timeline.
 """
 
 from __future__ import annotations

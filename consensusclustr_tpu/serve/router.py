@@ -49,6 +49,8 @@ and 0 swap-time ``executable_compiles``.
 from __future__ import annotations
 
 import inspect
+import itertools
+import os
 import queue
 import threading
 import time
@@ -94,6 +96,24 @@ _DEGRADED_ALERTS = frozenset({P99_ALERT, BURN_ALERT})
 # spot — the stale "ok" never strands a request.
 _HEALTH_TTL_S = 0.05
 
+# Fleet distributed tracing (ISSUE 19): hop-chain retention cap, the fleet
+# analogue of service.LIFECYCLE_RECORD_CAP — trace_ids past it still mint
+# and still serve, but their chains are not retained (fleet_traces_dropped
+# counts them), so a long-lived router stays bounded.
+DEFAULT_FLEET_TRACE_CAP = 100_000
+
+
+def fleet_trace_cap(requested: Optional[int] = None) -> int:
+    """Explicit arg > $CCTPU_FLEET_TRACE_CAP > 100_000 (docs/quirks.md)."""
+    if requested is None:
+        requested = int(
+            os.environ.get("CCTPU_FLEET_TRACE_CAP", DEFAULT_FLEET_TRACE_CAP)
+        )
+    v = int(requested)
+    if v < 0:
+        raise ValueError(f"fleet trace cap must be >= 0; got {v}")
+    return v
+
 
 class _Replica:
     """One owned service + the router's per-replica bookkeeping."""
@@ -117,15 +137,17 @@ class _Replica:
 class _Orphan:
     """An accepted request whose replica died before completing it."""
 
-    __slots__ = ("future", "counts", "mode", "attempts", "last_error", "t0")
+    __slots__ = ("future", "counts", "mode", "attempts", "last_error", "t0",
+                 "trace")
 
-    def __init__(self, future, counts, mode, t0) -> None:
+    def __init__(self, future, counts, mode, t0, trace=None) -> None:
         self.future = future
         self.counts = counts
         self.mode = mode
         self.attempts = 0
         self.last_error: Optional[BaseException] = None
         self.t0 = t0
+        self.trace = trace  # the hop chain follows the request, not the replica
 
 
 class FleetRouter:
@@ -178,6 +200,17 @@ class FleetRouter:
         self._orphans: "queue.Queue" = queue.Queue()
         self._last_revive = 0.0
         self._revivals = 0
+        # fleet distributed tracing (ISSUE 19): router-minted trace ids —
+        # minted HERE, not in the replica, because a replica can die before
+        # it would mint anything and only the router sees every hop of a
+        # request that crosses replicas. Hop chains are retained per
+        # trace_id up to the cap; retired replicas (revival-replaced or
+        # swap-drained) are kept so the merged FleetRecord still has the
+        # dead lane's spans and events.
+        self._trace_ids = itertools.count(1)
+        self._trace_cap = fleet_trace_cap()
+        self._traces: Dict[int, dict] = {}
+        self._retired: List[_Replica] = []
         self._failover = threading.Thread(
             target=self._failover_loop, name="cctpu-fleet-failover",
             daemon=True,
@@ -192,6 +225,7 @@ class FleetRouter:
         self._h_latency = self.metrics.histogram("serve_latency_seconds")
         self._g_queue_depth = self.metrics.gauge("fleet_replica_queue_depth")
         self._g_inflight = self.metrics.gauge("fleet_replica_inflight")
+        self._c_trace_drops = self.metrics.counter("fleet_traces_dropped")
         self._last_alert_eval = -1e9
         self._failover.start()
         self.metrics.gauge("fleet_replicas").set(len(self._replicas))
@@ -326,12 +360,96 @@ class FleetRouter:
         healthy, degraded, _, h = cached
         return healthy, degraded, int(rep.svc.in_flight), h, rep.admit
 
-    def _route_once(self, counts, mode):
+    # -- trace context (ISSUE 19) --------------------------------------------
+
+    def _mint_trace(self, t0: Optional[float] = None) -> dict:
+        """Mint the fleet-scoped trace context for one admission: the
+        trace_id plus an (initially empty) ordered hop chain. ``t_admit``
+        is on the router tracer's timeline (the merged-trace clock);
+        ``_t0`` is the perf_counter admission instant every hop's ``t``
+        is relative to (underscore keys never serialize); the caller
+        passes its own admission clock read so the chain and the fleet
+        latency share one origin exactly."""
+        tid = next(self._trace_ids)
+        trace = {
+            "trace_id": tid,
+            "t_admit": self.tracer.elapsed(),
+            "hops": [],
+            "_t0": t0 if t0 is not None else time.perf_counter(),
+        }
+        if tid <= self._trace_cap:
+            self._traces[tid] = trace
+        else:
+            self._c_trace_drops.inc()
+        return trace
+
+    def _drop_trace(self, trace: Optional[dict]) -> None:
+        """Forget a minted trace whose admission was rejected fleet-wide
+        (nothing was enqueued anywhere — there is no request to trace)."""
+        if trace is not None:
+            self._traces.pop(trace["trace_id"], None)
+
+    def _hop_for(self, trace: dict, rep: _Replica) -> dict:
+        """The next hop record for ``trace``: initial route, failover
+        re-route, or a re-route onto a revival slot (``~`` names). The
+        replica stamps ``req_id`` into this dict on accept — and
+        refines ``t`` to its own submit-entry clock read (the ``_t0``
+        passed along here), closing the preemption window between this
+        stamp and the submit call so hop parity is exact; the router
+        stamps ``outcome`` when the hop ends."""
+        k = len(trace["hops"])
+        kind = (
+            "route" if k == 0
+            else "revival" if "~" in rep.name
+            else "failover"
+        )
+        return {
+            "trace_id": trace["trace_id"],
+            "hop": k,
+            "replica": rep.name,
+            "kind": kind,
+            "t": round(time.perf_counter() - trace["_t0"], 6),
+            "_t0": trace["_t0"],
+        }
+
+    def trace_table(self) -> dict:
+        """Snapshot of every retained hop chain (obs/fleetobs.py merges
+        this into the FleetRecord ``trace`` block)."""
+        traces = []
+        for tr in list(self._traces.values()):
+            snap = {k: v for k, v in tr.items() if not k.startswith("_")}
+            snap["hops"] = [dict(h) for h in tr["hops"]]
+            traces.append(snap)
+        return {
+            "cap": self._trace_cap,
+            "retained": len(traces),
+            "dropped": int(self._c_trace_drops.value),
+            "traces": traces,
+        }
+
+    def replica_records(self) -> list:
+        """Every replica this router ever owned as ``(name, service,
+        retired)`` — current rotation first, then retired slots (revival-
+        replaced or swap-drained), whose tracers still hold the dead lane's
+        spans/events for the merged FleetRecord."""
+        with self._lock:
+            cur = list(self._replicas)
+            old = list(self._retired)
+        return (
+            [(r.name, r.svc, False) for r in cur]
+            + [(r.name, r.svc, True) for r in old]
+        )
+
+    # -- admission (continued) -----------------------------------------------
+
+    def _route_once(self, counts, mode, trace: Optional[dict] = None):
         """One admission pass over the current replica snapshot. Returns
         (replica, replica-future) or raises RetryableRejection when every
         admitting replica rejected. Returns (None, None) when no replica is
         even admitting (all unhealthy/shed) — the caller decides whether
-        that is a shed, a retry, or an orphan requeue."""
+        that is a shed, a retry, or an orphan requeue. A successful pass
+        appends one hop to ``trace`` (rejected/raced attempts append
+        nothing — the chain records where the request actually landed)."""
         with self._lock:
             reps = list(self._replicas)
         now = time.perf_counter()
@@ -361,8 +479,9 @@ class FleetRouter:
         scored.sort(key=lambda t: t[:3])
         rejected = 0
         for degraded, load, _, _, rep, h in scored:
+            hop = self._hop_for(trace, rep) if trace is not None else None
             try:
-                fut = rep.svc.submit(counts, mode=mode)
+                fut = rep.svc.submit(counts, mode=mode, trace=hop)
             except RetryableRejection:
                 rejected += 1
                 continue
@@ -373,6 +492,8 @@ class FleetRouter:
                 rep.score = None
                 self._mark_unhealthy(rep, "shutdown")
                 continue
+            if hop is not None:
+                trace["hops"].append(hop)  # req_id already stamped by submit
             rep.routed += 1
             self._c_routed.inc()
             return rep, fut
@@ -397,22 +518,30 @@ class FleetRouter:
         if self._closing or self._closed:
             raise RuntimeError("FleetRouter is shut down")
         t0 = time.perf_counter()
+        # mint the fleet-scoped trace identity at admission — before
+        # routing, so even a request that never lands anywhere had one
+        trace = self._mint_trace(t0)
         # two passes: a swap can atomically replace the replica list between
         # the snapshot and the submit — the refreshed snapshot sees the new
         # generation
-        for attempt in (0, 1):
-            rep, fut = self._route_once(counts, mode)
-            if rep is not None:
-                break
-        else:  # pragma: no cover - defensive; the loop always breaks or falls through with rep=None
-            rep, fut = None, None
+        try:
+            for attempt in (0, 1):
+                rep, fut = self._route_once(counts, mode, trace)
+                if rep is not None:
+                    break
+            else:  # pragma: no cover - defensive; the loop always breaks or falls through with rep=None
+                rep, fut = None, None
+        except RetryableRejection:
+            self._drop_trace(trace)  # nothing enqueued: no request to trace
+            raise
         if rep is None:
+            self._drop_trace(trace)
             raise RuntimeError(
                 "no replica in rotation (all unhealthy or draining)"
             )
         self._accepted += 1
         router_future: Future = Future()
-        self._chain(router_future, rep, fut, counts, mode, t0)
+        self._chain(router_future, rep, fut, counts, mode, t0, trace)
         return router_future
 
     def assign(
@@ -423,14 +552,18 @@ class FleetRouter:
 
     # -- completion + failover -----------------------------------------------
 
-    def _chain(self, router_future, rep, replica_future, counts, mode, t0):
+    def _chain(self, router_future, rep, replica_future, counts, mode, t0,
+               trace=None):
         def _done(fut):
             err = fut.exception()
             if err is None:
                 # observe BEFORE resolving: a caller that saw its result is
                 # already in the fleet histogram (loadgen metrics parity)
                 self._observe(t0)
-                router_future.set_result(fut.result())
+                result = fut.result()
+                if trace is not None:
+                    self._finish_trace(trace, result, t0)
+                router_future.set_result(result)
                 return
             # replica-death classification: the give-up path fails futures
             # AND closes intake, so a not-"ok" status means the error was
@@ -441,17 +574,63 @@ class FleetRouter:
                 dead = True
             if dead and not self._closing:
                 self.metrics.counter("fleet_failovers").inc()
+                if trace is not None and trace["hops"]:
+                    trace["hops"][-1]["outcome"] = "failover"
+                    trace["hops"][-1]["error"] = type(err).__name__
                 self.tracer.event(
                     "fleet_failover",
                     replica=rep.name,
                     error=type(err).__name__,
+                    trace_id=trace["trace_id"] if trace is not None else None,
                 )
-                self._orphans.put(_Orphan(router_future, counts, mode, t0))
+                self._orphans.put(
+                    _Orphan(router_future, counts, mode, t0, trace)
+                )
                 return
             self._completed += 1
+            if trace is not None and trace["hops"]:
+                trace["hops"][-1]["outcome"] = "error"
+                trace["hops"][-1]["error"] = type(err).__name__
             router_future.set_exception(err)
 
         replica_future.add_done_callback(_done)
+
+    def _finish_trace(self, trace: dict, result: AssignResult, t0) -> None:
+        """Close the hop chain on completion and ride the whole chain back
+        to the caller on ``AssignResult.timing["trace"]``. The per-request
+        invariant tools/loadgen.py audits (``hop_parity``): the final hop's
+        admission-relative ``t`` plus its replica-measured latency equals
+        the client-observed fleet latency within PHASE_PARITY_TOL — all
+        hops, backoffs and re-route gaps accounted for."""
+        # the replica's absolute resolution instant (same process, same
+        # perf_counter clock): both the chain's latency endpoint and the
+        # final hop's serve span end on it, so hop-parity is exact by
+        # construction — resolved_s covers submit-entry -> resolution, the
+        # hop ``t`` was stamped immediately before that submit entry, and
+        # callback-scheduling jitter cancels out of the identity
+        t_res = result.timing.pop("_t_resolved", None)
+        hops = trace["hops"]
+        if hops:
+            hops[-1]["outcome"] = "ok"
+            # resolved_s, not latency_s: latency_s ends at the batch's
+            # shared t_done (exact three-interval decomposition), while the
+            # hop chain must cover the replica's per-request host work too
+            hops[-1]["serve_latency_s"] = round(
+                float(
+                    result.timing.get("resolved_s")
+                    or result.timing.get("latency_s")
+                    or 0.0
+                ),
+                6,
+            )
+        trace["fleet_latency_s"] = round(
+            (t_res if t_res is not None else time.perf_counter()) - t0, 6
+        )
+        result.timing["trace"] = {
+            "trace_id": trace["trace_id"],
+            "fleet_latency_s": trace["fleet_latency_s"],
+            "hops": [dict(h) for h in hops],
+        }
 
     def _observe(self, t0: float) -> None:
         self._completed += 1
@@ -505,6 +684,9 @@ class FleetRouter:
                     continue
                 self._revivals += 1
                 fresh = _Replica(fresh_name, svc)
+                # retire, don't drop: the dead slot's tracer holds the
+                # spans/events the merged FleetRecord renders as its lane
+                self._retired.append(rep)
                 self._replicas[i] = fresh
                 revived += 1
                 self.tracer.event(
@@ -533,14 +715,16 @@ class FleetRouter:
                 continue
             orphan.attempts += 1
             try:
-                rep, fut = self._route_once(orphan.counts, orphan.mode)
+                rep, fut = self._route_once(
+                    orphan.counts, orphan.mode, orphan.trace
+                )
             except RetryableRejection as e:
                 orphan.last_error = e
                 rep, fut = None, None
             if rep is not None:
                 self._chain(
                     orphan.future, rep, fut, orphan.counts, orphan.mode,
-                    orphan.t0,
+                    orphan.t0, orphan.trace,
                 )
                 continue
             if orphan.attempts >= _ORPHAN_ATTEMPT_LIMIT or self._closing:
@@ -616,6 +800,10 @@ class FleetRouter:
                 before = rep.svc.health()
                 rep.svc.close()  # drains: every accepted request completes
                 drained += int(before.get("in_flight", 0))
+            with self._lock:
+                # retired, not dropped: the drained generation's lanes stay
+                # renderable in the merged FleetRecord (drain handoffs)
+                self._retired.extend(old)
             swap_compiles = int(compiles.value - compiles0)
             wall_s = round(time.perf_counter() - t0, 4)
             self.metrics.counter("fleet_swaps").inc()
@@ -690,3 +878,13 @@ class FleetRouter:
             self.tracer, config=config, backend=default_backend(),
             include_global_metrics=False,
         )
+
+    def fleet_record(self, config=None):
+        """Merge this router's record, every replica's (live and retired)
+        record, and the retained hop chains into one schema-v11
+        :class:`~consensusclustr_tpu.obs.fleetobs.FleetRecord` — the fleet
+        incident artifact tools/timeline.py and the Perfetto fleet export
+        render."""
+        from consensusclustr_tpu.obs.fleetobs import FleetRecord
+
+        return FleetRecord.from_router(self, config=config)

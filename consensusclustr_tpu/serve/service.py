@@ -260,17 +260,30 @@ class _MetricsHTTPServer:
 class _Request:
     __slots__ = (
         "counts_hvg", "mode", "future", "req_id",
-        "t_submit", "t_dequeue", "rows",
+        "t_submit", "t_dequeue", "rows", "trace", "t_enter",
     )
 
-    def __init__(self, counts_hvg: np.ndarray, mode: str, req_id: int) -> None:
+    def __init__(
+        self, counts_hvg: np.ndarray, mode: str, req_id: int,
+        trace: Optional[dict] = None,
+        t_enter: Optional[float] = None,
+    ) -> None:
         self.counts_hvg = counts_hvg
         self.mode = mode
         self.future: Future = Future()
         self.req_id = req_id
         self.t_submit = time.perf_counter()   # enqueue instant
+        # submit()-call entry (before HVG subsetting): the client-observed
+        # start the ISSUE 19 hop chain measures from — a fleet hop is
+        # stamped immediately before the submit call, so resolved_s from
+        # here makes the hop-parity identity exact (no unattributed
+        # pre-enqueue host work)
+        self.t_enter = t_enter if t_enter is not None else self.t_submit
         self.t_dequeue: Optional[float] = None  # worker pop (queue_wait end)
         self.rows = int(counts_hvg.shape[0])
+        # fleet trace context (ISSUE 19): the router-minted hop dict —
+        # carries trace_id/hop in, gets this replica's req_id stamped back
+        self.trace = trace
 
 
 class AssignmentService:
@@ -561,13 +574,26 @@ class AssignmentService:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, counts, mode: Optional[str] = None) -> Future:
+    def submit(
+        self, counts, mode: Optional[str] = None,
+        trace: Optional[dict] = None,
+    ) -> Future:
         """Enqueue one request; returns a Future of AssignResult.
 
         Raises :class:`RetryableRejection` when the queue is full (nothing
         enqueued — back off and retry) and ValueError for batches larger
         than ``serve_max_batch`` (split them client-side).
+
+        ``trace`` (ISSUE 19) is the FleetRouter's hop dict for this
+        admission — a mutable contract: the router supplies
+        ``trace_id``/``hop``/``replica``, this service stamps ``req_id``
+        back into it once the request is actually accepted (a rejected
+        submit leaves it unstamped), and the id pair rides the
+        ``serve_request`` event, the ``serve_batch`` span and
+        ``AssignResult.timing`` so one fleet-scoped identity links the
+        per-replica fragments.
         """
+        t_enter = time.perf_counter()
         if self._closing or self._closed:
             raise RuntimeError("AssignmentService is shut down")
         mode = self.mode if mode is None else mode
@@ -579,7 +605,10 @@ class AssignmentService:
                 f"request of {counts_hvg.shape[0]} rows exceeds "
                 f"serve_max_batch={self.max_batch}; split it client-side"
             )
-        req = _Request(counts_hvg, mode, next(self._req_ids))
+        req = _Request(
+            counts_hvg, mode, next(self._req_ids), trace=trace,
+            t_enter=t_enter,
+        )
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -591,11 +620,30 @@ class AssignmentService:
                 retry_after_s=hint,
             ) from None
         self._accepted += 1
+        if trace is not None:
+            # accepted: stamp this replica's req_id into the router's hop
+            # record — the trace_id <-> req_id join key for merged traces
+            trace["req_id"] = req.req_id
+            # refine the hop's route stamp to THIS submit call's entry
+            # clock read (the router stamped it just before calling us):
+            # resolved_s below measures from the same t_enter, so the
+            # hop-parity identity carries no unattributed gap
+            t0h = trace.pop("_t0", None)
+            if t0h is not None:
+                trace["t"] = round(t_enter - t0h, 6)
         self.metrics.gauge("queue_depth").set(self._queue.qsize())
         if req.req_id <= LIFECYCLE_RECORD_CAP:
             # the request's flow-event anchor: obs/export.py links this
             # instant to the serve_batch span that carries req_id
-            self.tracer.event("serve_request", req_id=req.req_id, rows=req.rows)
+            if trace is not None:
+                self.tracer.event(
+                    "serve_request", req_id=req.req_id, rows=req.rows,
+                    trace_id=trace.get("trace_id"),
+                )
+            else:
+                self.tracer.event(
+                    "serve_request", req_id=req.req_id, rows=req.rows
+                )
         return req.future
 
     def assign(self, counts, mode: Optional[str] = None, timeout=None) -> AssignResult:
@@ -746,6 +794,12 @@ class AssignmentService:
             n_requests=len(batch),
             rows=rows,
         )
+        trace_ids = [
+            r.trace["trace_id"] for r in batch
+            if r.trace is not None and "trace_id" in r.trace
+        ]
+        if trace_ids:
+            attrs["trace_ids"] = trace_ids
         if batch[0].req_id > LIFECYCLE_RECORD_CAP:
             return _null_span("serve_batch", **attrs)
         return self.tracer.span("serve_batch", **attrs)
@@ -829,6 +883,17 @@ class AssignmentService:
                             "bucket": bucket,
                             "batch_rows": rows,
                             "batch_requests": len(batch),
+                            # fleet trace context when routed (ISSUE 19);
+                            # the router replaces these with the full hop
+                            # chain under timing["trace"] on completion
+                            **(
+                                {
+                                    "trace_id": req.trace.get("trace_id"),
+                                    "hop": req.trace.get("hop"),
+                                }
+                                if req.trace is not None
+                                else {}
+                            ),
                         },
                     )
                     self.metrics.histogram("serve_latency_seconds").observe(
@@ -841,6 +906,19 @@ class AssignmentService:
                         batch_wait
                     )
                     self.metrics.histogram("device_seconds").observe(device_s)
+                    # submit-entry -> resolution wall, stamped LAST: unlike
+                    # latency_s (which runs t_submit -> the shared t_done so
+                    # the three-interval decomposition stays exact), this
+                    # covers HVG subsetting before the enqueue AND the
+                    # per-request host assembly above — what a caller of
+                    # submit() actually observes (ISSUE 19 hop parity)
+                    t_res = time.perf_counter()
+                    result.timing["resolved_s"] = t_res - req.t_enter
+                    if req.trace is not None:
+                        # absolute resolution instant for the router's
+                        # _finish_trace (same process, same perf_counter
+                        # clock) — popped there, never serialized
+                        result.timing["_t_resolved"] = t_res
                     req.future.set_result(result)
                     self._completed += 1
                     s = e

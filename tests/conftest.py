@@ -22,6 +22,12 @@ import pytest  # noqa: E402
 
 assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
+# The ONE place the obs schema pin lives (ISSUE 19 satellite): schema-bump
+# PRs edit this constant plus obs/schema.py's SCHEMA_VERSION and the tests
+# that import it follow — instead of a grep across five test files for a
+# stale literal.
+CURRENT_OBS_SCHEMA = 11
+
 # Capability gate for the sharded (shard_map) paths: when the environment's
 # jax predates the jax.shard_map / varying-manual-axes API (or has a single
 # device), those tests SKIP with the environment reason instead of failing —

@@ -1,0 +1,15 @@
+"""GL002 fixture (ISSUE 19): a fleet-trace knob read but never registered.
+
+The distributed-tracing layer added CCTPU_FLEET_TRACE_CAP /
+CCTPU_FLEET_TRACE_PATH to obs.schema.ENV_KNOBS; this module simulates
+the drift the rule exists to catch — a new CCTPU_FLEET_TRACE_* read that
+skipped the registry. The knob name below must stay OUT of ENV_KNOBS
+forever: the test copies this file into a synthetic package root and
+asserts GL002 exits 3 naming it.
+"""
+
+import os
+
+
+def trace_sample_rate() -> float:
+    return float(os.environ.get("CCTPU_FLEET_TRACE_FOO", "1.0") or 1.0)

@@ -21,6 +21,7 @@ import sys
 
 import numpy as np
 import pytest
+from conftest import CURRENT_OBS_SCHEMA
 
 from consensusclustr_tpu.serve.control import (
     BURN_DEADLINE_FACTOR,
@@ -269,6 +270,7 @@ class TestHotSwap:
             assert set(fleet.routed_per_replica()) == {"r0.v1", "r1.v1"}
             assert fleet.metrics.counter("fleet_swaps").value == 1
 
+    @pytest.mark.slow  # subprocess cold-start: ISSUE 19 tier-1 budget
     def test_swap_straddling_loadgen_has_zero_failures(self, tmp_path):
         # the ISSUE 18 pin, isolated in a subprocess so the global compile
         # counter sees ONLY this fleet: a loadgen run straddles the swap
@@ -399,7 +401,7 @@ class TestSchemaV10:
     def test_schema_version(self):
         from consensusclustr_tpu.obs.schema import SCHEMA_VERSION
 
-        assert SCHEMA_VERSION == 10
+        assert SCHEMA_VERSION == CURRENT_OBS_SCHEMA
 
     def test_fleet_vocabulary_registered(self):
         from consensusclustr_tpu.obs import schema
@@ -428,7 +430,7 @@ class TestSchemaV10:
                 fleet.assign(q, timeout=120)
         rec = fleet.run_record()  # post-close: fleet_drain is in the ring
         d = json.loads(rec.to_json())
-        assert d["schema"] == 10
+        assert d["schema"] == CURRENT_OBS_SCHEMA
         counters = (d.get("metrics") or {}).get("counters") or {}
         assert counters.get("fleet_requests_routed") == 3
         kinds = {e.get("kind") for e in d.get("events") or []}
@@ -440,11 +442,13 @@ class TestSchemaV10:
         text = report.render(json.loads(path.read_text()))
         assert "== fleet ==" in text
         assert "requests routed" in text
-        assert "WARNING: unknown schema" not in text  # v10 is known
+        assert "WARNING: unknown schema" not in text  # current schema is known
 
     def test_report_without_fleet_metrics_placeholder(self):
         report = _load_tool("report")
-        text = report.render({"schema": 10, "metrics": {"counters": {}}})
+        text = report.render(
+            {"schema": CURRENT_OBS_SCHEMA, "metrics": {"counters": {}}}
+        )
         assert "(no fleet activity)" in text
 
 
@@ -471,7 +475,7 @@ class TestBenchShapes:
         assert zero is not None, "bench.py lost _FLEET_SLO_ZERO"
         assert set(zero) == {
             "fleet_slo", "fleet_p99_ms", "fleet_rejection_rate",
-            "fleet_routed", "fleet_swap_compiles",
+            "fleet_routed", "fleet_swap_compiles", "fleet_trace",
         }
         assert zero["fleet_slo"] == {"steps": []}
 

@@ -1,0 +1,390 @@
+"""Fleet-wide distributed tracing (ISSUE 19): trace-context propagation
+across replicas, the merged FleetRecord artifact, the Perfetto fleet
+export's cross-replica flow links, and the causal incident timeline.
+
+The heavyweight piece — a loadgen-shaped wave through the
+``fleet_replica_death`` chaos fault — runs ONCE in a module fixture and
+every chain/flow/timeline assertion reads that single artifact.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from conftest import CURRENT_OBS_SCHEMA
+
+from consensusclustr_tpu.obs.fleetobs import FLEET_RECORD_KIND, FleetRecord
+from consensusclustr_tpu.resilience.inject import clear_fault, install_fault
+from consensusclustr_tpu.serve.fleet import build_fleet
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GENES = 32
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def art():
+    lg = _load_tool("loadgen")
+    artifact, _ = lg.synthetic_artifact(128, GENES, seed=0)
+    return artifact
+
+
+def _queries(sizes=(1, 3, 5), seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.poisson(2.0, size=(s, GENES)).astype(np.float32) for s in sizes
+    ]
+
+
+class TestTracePropagation:
+    def test_timing_carries_hop_chain(self, art):
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            res = fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+        trace = res.timing.get("trace")
+        assert trace is not None
+        assert isinstance(trace["trace_id"], int)
+        assert trace["fleet_latency_s"] > 0.0
+        (hop,) = trace["hops"]
+        assert hop["kind"] == "route"
+        assert hop["outcome"] == "ok"
+        assert hop["replica"] in ("r0", "r1")
+        assert hop["req_id"] == res.timing["req_id"]
+        # the replica stamped the shared context onto its own timing too
+        assert res.timing["trace_id"] == trace["trace_id"]
+        assert res.timing["hop"] == 0
+        # underscore (clock-plumbing) keys never serialize
+        assert not any(k.startswith("_") for k in trace)
+        assert not any(k.startswith("_") for k in hop)
+
+    def test_trace_table_retains_every_admission(self, art):
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            for q in _queries():
+                fleet.assign(q, timeout=120)
+            table = fleet.trace_table()
+        assert table["retained"] == 3
+        assert table["dropped"] == 0
+        ids = [tr["trace_id"] for tr in table["traces"]]
+        assert len(set(ids)) == 3
+        assert all(tr["hops"] for tr in table["traces"])
+
+    def test_trace_cap_drops_chains_not_requests(self, art, monkeypatch):
+        monkeypatch.setenv("CCTPU_FLEET_TRACE_CAP", "2")
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            results = [
+                fleet.assign(q, timeout=120) for q in _queries()
+            ]
+            table = fleet.trace_table()
+        assert all(r.labels is not None for r in results)  # requests served
+        assert table["cap"] == 2
+        assert table["retained"] == 2
+        assert table["dropped"] == 1
+
+    def test_hop_parity_within_phase_parity_bound(self, art):
+        lg = _load_tool("loadgen")
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            timings = [
+                fleet.assign(q, timeout=120).timing for q in _queries()
+            ]
+        parity = lg.hop_parity(timings)
+        assert parity["checked"] == 3
+        # the ISSUE 19 invariant: the last hop's offset plus its serve
+        # latency reproduces the client-observed fleet latency (exact by
+        # construction — one perf_counter origin; gate at the 5% phase-
+        # parity tolerance)
+        assert parity["within_5pct"], parity
+        assert parity["max_rel_err"] <= lg.PHASE_PARITY_TOL
+
+
+class TestFleetRecord:
+    def test_round_trip_and_summary(self, art, tmp_path):
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            for q in _queries():
+                fleet.assign(q, timeout=120)
+            frec = fleet.fleet_record()
+        assert frec.schema == CURRENT_OBS_SCHEMA
+        path = frec.write(str(tmp_path / "fleet.json"))
+        back = FleetRecord.load(path)
+        doc = json.loads(open(path, encoding="utf-8").read())
+        assert doc["kind"] == FLEET_RECORD_KIND
+        assert back.schema == CURRENT_OBS_SCHEMA
+        assert [r["name"] for r in back.replicas] == ["r0", "r1"]
+        assert back.routed == frec.routed
+        assert back.summary() == {
+            "replicas": 2, "retired": 0, "traces": 3, "multi_hop": 0,
+            "dropped": 0,
+        }
+
+    def test_chrome_trace_process_lanes(self, art, tmp_path):
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            for q in _queries():
+                fleet.assign(q, timeout=120)
+            frec = fleet.fleet_record()
+        out = str(tmp_path / "fleet_trace.json")
+        frec.to_chrome_trace(out)
+        events = json.load(open(out, encoding="utf-8"))["traceEvents"]
+        lanes = {
+            e["args"]["name"]: e["pid"]
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert lanes["fleet_router"] == 1
+        assert {"replica:r0", "replica:r1"} <= set(lanes)
+        assert len(set(lanes.values())) == len(lanes)  # one pid per lane
+        # fleet gauges replay as counter tracks on the router lane
+        counters = {
+            e["name"] for e in events
+            if e.get("ph") == "C" and e.get("pid") == 1
+        }
+        assert "fleet_replicas" in counters
+        assert all(e.get("ts", 0) >= 0 for e in events)  # rebased clocks
+
+
+# -- the incident artifact: loadgen wave through fleet_replica_death ----------
+
+DEATH_GENES = 128
+DEATH_ROWS = 256
+
+
+@pytest.fixture(scope="module")
+def death_artifact(tmp_path_factory):
+    """One fault-injected fleet run (the ``fleet_replica_death`` chaos
+    fault mid-traffic): slow 256-row batches keep both workers busy while
+    a second wave queues behind them, so the planted death orphans the
+    queued wave and the failover/revival machinery re-routes it. Returns
+    (fleet-record dict, artifact path, per-request timings)."""
+    lg = _load_tool("loadgen")
+    art, _ = lg.synthetic_artifact(2048, DEATH_GENES, seed=0)
+    rng = np.random.default_rng(5)
+    big = [
+        rng.poisson(2.0, size=(DEATH_ROWS, DEATH_GENES)).astype(np.float32)
+        for _ in range(6)
+    ]
+    with build_fleet(
+        art, 2, queue_depth=32, max_batch=256, buckets=(256,)
+    ) as fleet:
+        fleet.assign(big[0], timeout=120)  # warm: workers past first compile
+        install_fault("serve_worker:raise_always")
+        try:
+            # wave A occupies both workers in a ~100ms batch; wave B queues
+            # behind them and orphans when the workers die at loop top
+            futures = [fleet.submit(q) for q in big[:2]]
+            futures += [fleet.submit(q) for q in big[2:]]
+            time.sleep(0.35)
+        finally:
+            clear_fault()
+        timings = [f.result(timeout=120).timing for f in futures]
+        frec = fleet.fleet_record()
+    path = str(tmp_path_factory.mktemp("incident") / "fleet_incident.json")
+    frec.write(path)
+    return frec.to_dict(), path, timings
+
+
+class TestReplicaDeathChains:
+    def test_no_request_lost_and_chains_complete(self, death_artifact):
+        doc, _, timings = death_artifact
+        assert len(timings) == 6  # every accepted request completed
+        frec = FleetRecord.from_dict(doc)
+        multi = frec.multi_hop_traces()
+        assert multi, "the planted death must orphan at least one request"
+        for tr in multi:
+            hops = tr["hops"]
+            # complete chain: admission route -> dead replica(s) marked
+            # failover -> a terminal hop that completed the request
+            assert hops[0]["kind"] == "route"
+            assert all(h["outcome"] == "failover" for h in hops[:-1])
+            assert hops[-1]["outcome"] == "ok"
+            assert hops[-1]["kind"] in ("revival", "failover")
+            # hop indices are the chain order
+            assert [h["hop"] for h in hops] == list(range(len(hops)))
+
+    def test_revival_completed_orphans(self, death_artifact):
+        doc, _, _ = death_artifact
+        frec = FleetRecord.from_dict(doc)
+        # both replicas died (the fault is global): completions came from
+        # revival slots, whose lanes must be in the merged record
+        assert any(
+            tr["hops"][-1]["kind"] == "revival"
+            and "~" in tr["hops"][-1]["replica"]
+            for tr in frec.multi_hop_traces()
+        )
+        names = {r["name"] for r in frec.replicas}
+        assert any("~" in n for n in names)
+        assert sum(1 for r in frec.replicas if r["retired"]) >= 2
+
+    def test_hop_parity_exact_on_failover_chains(self, death_artifact):
+        _, _, timings = death_artifact
+        lg = _load_tool("loadgen")
+        parity = lg.hop_parity(timings)
+        assert parity["checked"] == 6
+        assert parity["within_5pct"], parity
+
+    def test_flow_link_per_rerouted_request(self, death_artifact, tmp_path):
+        doc, _, _ = death_artifact
+        frec = FleetRecord.from_dict(doc)
+        out = str(tmp_path / "incident_trace.json")
+        frec.to_chrome_trace(out)
+        events = json.load(open(out, encoding="utf-8"))["traceEvents"]
+        flows = [e for e in events if e.get("cat") == "fleet"
+                 and e.get("ph") in ("s", "t", "f")]
+        starts = {e["id"] for e in flows if e["ph"] == "s"}
+        finishes = {e["id"] for e in flows if e["ph"] == "f"}
+        multi_ids = {tr["trace_id"] for tr in frec.multi_hop_traces()}
+        # one full s...f arrow sequence per re-routed request
+        assert starts == multi_ids
+        assert finishes == multi_ids
+        for tid in multi_ids:
+            chain = [e for e in flows if e["id"] == tid]
+            # the arrow crosses process lanes: admission-side hop and the
+            # completing hop live on different replicas
+            assert len({e["pid"] for e in chain}) >= 2
+            ts = [e["ts"] for e in chain]
+            assert ts == sorted(ts)
+
+    def test_timeline_names_death_failover_revival(self, death_artifact):
+        doc, _, _ = death_artifact
+        tl = _load_tool("timeline")
+        entries = tl.fold(doc)
+        kinds = [e["kind"] for e in entries]
+        assert "fleet_replica_down" in kinds
+        assert "fleet_failover" in kinds
+        assert "fleet_replica_revived" in kinds
+        # causal order: death detection (the failed submit that fires the
+        # failover, then the down bookkeeping) precedes the revival that
+        # completes the story
+        first_detect = min(
+            kinds.index("fleet_failover"), kinds.index("fleet_replica_down")
+        )
+        last_revival = (
+            len(kinds) - 1 - kinds[::-1].index("fleet_replica_revived")
+        )
+        assert first_detect < last_revival
+        assert kinds.index("fleet_replica_down") < last_revival
+        downs = [
+            e["detail"].get("replica") for e in entries
+            if e["kind"] == "fleet_replica_down"
+        ]
+        assert any(str(d).startswith("r") for d in downs)  # named, not blank
+
+    def test_timeline_cli_render_and_diff(self, death_artifact, tmp_path):
+        _, path, _ = death_artifact
+        script = os.path.join(REPO_ROOT, "tools", "timeline.py")
+        render = subprocess.run(
+            [sys.executable, script, "render", path, "--limit", "25"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert render.returncode == 0, render.stderr
+        assert render.stdout.startswith("fleet timeline: schema=")
+        assert "fleet_failover" in render.stdout
+        # self-diff is clean
+        same = subprocess.run(
+            [sys.executable, script, "diff", path, path],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert same.returncode == 0
+        assert "timelines match" in same.stdout
+        # a doctored artifact (one causal step removed) diverges at exit 3
+        doc = json.load(open(path, encoding="utf-8"))
+        doc["router"]["events"] = [
+            e for e in doc["router"]["events"]
+            if e.get("kind") != "fleet_failover"
+        ]
+        doctored = str(tmp_path / "doctored.json")
+        json.dump(doc, open(doctored, "w"))
+        diff = subprocess.run(
+            [sys.executable, script, "diff", path, doctored],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert diff.returncode == 3
+        assert "timeline diverges at entry" in diff.stdout
+        # usage / unreadable artifact: exit 1 (bench_diff convention)
+        usage = subprocess.run(
+            [sys.executable, script, "render"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert usage.returncode == 1
+        missing = subprocess.run(
+            [sys.executable, script, "render", str(tmp_path / "nope.json")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert missing.returncode == 1
+
+
+class TestSwapTrace:
+    def test_swap_phases_on_router_lane(self, art, tmp_path):
+        lg = _load_tool("loadgen")
+        art2, _ = lg.synthetic_artifact(128, GENES, seed=0)
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+            report = fleet.swap_reference(art2)
+            fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+            frec = fleet.fleet_record()
+        assert report["generation"] == 1
+        assert frec.generation == 1
+        # the drained generation's lanes survive as retired processes
+        summary = frec.summary()
+        assert summary["replicas"] == 4
+        assert summary["retired"] == 2
+        out = str(tmp_path / "swap_trace.json")
+        frec.to_chrome_trace(out)
+        events = json.load(open(out, encoding="utf-8"))["traceEvents"]
+        swap_slices = [
+            e for e in events
+            if e.get("ph") == "X" and e.get("name") == "fleet_swap"
+        ]
+        assert swap_slices and all(e["pid"] == 1 for e in swap_slices)
+        retired_lanes = [
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "(retired)" in e["args"]["name"]
+        ]
+        assert len(retired_lanes) == 2
+
+
+class TestReportTimelineSection:
+    def test_report_embeds_timeline_fold(self, art):
+        report = _load_tool("report")
+        with build_fleet(
+            art, 2, queue_depth=8, max_batch=16, buckets=(16,)
+        ) as fleet:
+            fleet.assign(_queries(sizes=(2,))[0], timeout=120)
+            rec = fleet.run_record()
+        text = report.render(json.loads(rec.to_json()))
+        assert "== timeline ==" in text
+        assert "fleet_start" in text
+        assert "WARNING: unknown schema" not in text
+
+    def test_quiet_record_renders_placeholder(self):
+        report = _load_tool("report")
+        text = report.render(
+            {"schema": CURRENT_OBS_SCHEMA, "metrics": {"counters": {}}}
+        )
+        assert "== timeline ==" in text
+        assert "(no incident entries)" in text

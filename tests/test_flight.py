@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 import pytest
+from conftest import CURRENT_OBS_SCHEMA
 
 from consensusclustr_tpu.api import consensus_clust
 from consensusclustr_tpu.obs import RunRecord, Tracer
@@ -466,7 +467,7 @@ class TestAlertRules:
 
 class TestSchemaV8:
     def test_registry_entries(self):
-        assert obs_schema.SCHEMA_VERSION == 10
+        assert obs_schema.SCHEMA_VERSION == CURRENT_OBS_SCHEMA
         for kind in (
             "stall_detected", "postmortem_dump", "alert_raised",
             "alert_cleared",
@@ -492,7 +493,7 @@ class TestSchemaV8:
             tr.metrics.counter("boots_completed").inc()
         tr.flight.dump(MANUAL_FLIGHT, path=rec_path)
         rec = RunRecord.from_tracer(tr)
-        assert rec.schema == 10
+        assert rec.schema == CURRENT_OBS_SCHEMA
         assert rec.postmortem_path == rec_path
         assert rec.alerts is not None and rec.alerts["active"] == {}
         path = str(tmp_path / "rec.jsonl")

@@ -367,6 +367,34 @@ class TestEnvKnobRegistry:
         assert hits, [f.message for f in res.violations]
         assert "pr18_unregistered_fleet_knob.py" in hits[0].path
 
+    def test_fleet_trace_knobs_are_registered(self):
+        from consensusclustr_tpu.obs import schema
+
+        # ISSUE 19: the distributed-tracing knobs ride the registry like
+        # every other CCTPU_* read
+        for knob in ("CCTPU_FLEET_TRACE_CAP", "CCTPU_FLEET_TRACE_PATH"):
+            assert knob in schema.ENV_KNOBS
+
+    def test_unregistered_fleet_trace_knob_exits_three(self, tmp_path):
+        # ISSUE 19 fixture: a CCTPU_FLEET_TRACE_* read that skipped
+        # ENV_KNOBS must trip GL002 at exit 3 naming the knob
+        pkg = tmp_path / "consensusclustr_tpu"
+        pkg.mkdir()
+        src = open(
+            _fixture("pr19_unregistered_trace_knob.py"), encoding="utf-8"
+        ).read()
+        (pkg / "pr19_unregistered_trace_knob.py").write_text(src)
+        res = core.run(
+            root=str(tmp_path), select=["GL002"], baseline_path=None
+        )
+        assert res.exit_code == 3
+        hits = [
+            f for f in res.violations
+            if f.code == "GL002" and "CCTPU_FLEET_TRACE_FOO" in f.message
+        ]
+        assert hits, [f.message for f in res.violations]
+        assert "pr19_unregistered_trace_knob.py" in hits[0].path
+
 
 class TestCheckObsSchemaWrapper:
     """The thin wrapper keeps its import surface and CLI contract."""

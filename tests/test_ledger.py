@@ -30,6 +30,7 @@ import sys
 
 import numpy as np
 import pytest
+from conftest import CURRENT_OBS_SCHEMA
 
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.consensus.pipeline import run_bootstraps
@@ -121,7 +122,7 @@ class TestWorkLedgerCore:
         with tr.span("boots"):
             tr.metrics.counter("boots_completed").inc(4)
         rec = RunRecord.from_tracer(tr)
-        assert rec.schema == 10
+        assert rec.schema == CURRENT_OBS_SCHEMA
         assert rec.work_ledger is not None
         assert rec.work_ledger["counters"]["boots_completed"] == 4
         path = str(tmp_path / "rec.jsonl")

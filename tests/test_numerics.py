@@ -17,6 +17,7 @@ import sys
 
 import numpy as np
 import pytest
+from conftest import CURRENT_OBS_SCHEMA
 
 from consensusclustr_tpu.config import ClusterConfig
 from consensusclustr_tpu.consensus.pipeline import consensus_cluster
@@ -394,14 +395,14 @@ class TestSchemaV6:
         return RunRecord.from_tracer(tr)
 
     def test_record_round_trip(self, tmp_path):
-        assert SCHEMA_VERSION == 10
+        assert SCHEMA_VERSION == CURRENT_OBS_SCHEMA
         rec = self._audited_record()
         path = str(tmp_path / "rec.jsonl")
         rec.write(path)
         from consensusclustr_tpu.obs import load_records
 
         back = load_records(path)[-1]
-        assert back.schema == 10
+        assert back.schema == CURRENT_OBS_SCHEMA
         assert back.numerics == rec.numerics
         assert back.numerics["level"] == "audit"
         assert back.numerics["nonfinite"] == 1
@@ -410,7 +411,7 @@ class TestSchemaV6:
         ]
 
     def test_registry_entries(self):
-        assert obs_schema.SCHEMA_VERSION == 10
+        assert obs_schema.SCHEMA_VERSION == CURRENT_OBS_SCHEMA
         assert "pca" in obs_schema.NUMERIC_CHECKPOINTS
         assert "numeric_fingerprint" in obs_schema.EVENT_KINDS
         assert "numerics_nonfinite" in obs_schema.METRIC_NAMES

@@ -28,6 +28,7 @@ import time
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import CURRENT_OBS_SCHEMA
 
 from consensusclustr_tpu.api import consensus_clust
 from consensusclustr_tpu.obs import RunRecord, Tracer, global_metrics
@@ -333,7 +334,7 @@ class TestOffIsFree:
 
 class TestSchemaV9:
     def test_registries(self):
-        assert obs_schema.SCHEMA_VERSION == 10
+        assert obs_schema.SCHEMA_VERSION == CURRENT_OBS_SCHEMA
         assert len(obs_schema.PROGRAM_NAMES) >= 10
         assert "_boot_batch" in obs_schema.PROGRAM_NAMES
         assert obs_schema.PROGRAM_PROFILE_FIELDS == frozenset(
@@ -366,7 +367,7 @@ class TestSchemaV9:
 
     def test_record_round_trip(self, tmp_path):
         rec = self._record_with_profile()
-        assert rec.schema == 10
+        assert rec.schema == CURRENT_OBS_SCHEMA
         assert rec.program_profile is not None
         assert rec.profile is not None and rec.profile["stacks"]
         path = str(tmp_path / "rec.jsonl")
@@ -374,7 +375,7 @@ class TestSchemaV9:
         from consensusclustr_tpu.obs import load_records
 
         back = load_records(path)[-1]
-        assert back.schema == 10
+        assert back.schema == CURRENT_OBS_SCHEMA
         assert back.program_profile == rec.program_profile
         assert back.profile == rec.profile
 

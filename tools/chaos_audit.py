@@ -318,12 +318,36 @@ class ChaosHarness:
                     reg.counter("fleet_replica_unhealthy").value
                 )
                 routed = fleet.routed_per_replica()
+                # merged fleet observability (ISSUE 19): capture while the
+                # replica services (including retired slots) are still open
+                frec = fleet.fleet_record()
         finally:
             clear_fault()
             if prev is None:
                 os.environ.pop("CCTPU_POSTMORTEM_PATH", None)
             else:
                 os.environ["CCTPU_POSTMORTEM_PATH"] = prev
+        trace_path = os.environ.get("CCTPU_FLEET_TRACE_PATH") or (
+            os.path.join(
+                os.path.dirname(os.path.abspath(pm_path)),
+                "fleet_incident.json",
+            )
+        )
+        frec.write(trace_path)
+        # chain completeness: every re-routed (multi-hop) request must carry
+        # admission -> dead replica (outcome=failover) -> terminal hop that
+        # completed (outcome=ok); a dangling chain means a hop went
+        # unrecorded and the incident artifact lies about causality
+        multi = frec.multi_hop_traces()
+        chains_complete = bool(multi) and all(
+            tr.get("hops")
+            and tr["hops"][0].get("kind") == "route"
+            and all(
+                h.get("outcome") == "failover" for h in tr["hops"][:-1]
+            )
+            and tr["hops"][-1].get("outcome") == "ok"
+            for tr in multi
+        )
         return {
             "fires": inj.total_fires,
             "lost": lost,
@@ -332,6 +356,10 @@ class ChaosHarness:
             "failovers": failovers,
             "replica_unhealthy": unhealthy,
             "routed": routed,
+            "fleet_trace": frec.summary(),
+            "fleet_trace_path": trace_path,
+            "chains_complete": chains_complete,
+            "multi_hop": len(multi),
         }
 
     # -- null statistics -----------------------------------------------------
@@ -638,7 +666,37 @@ def audit_preset(name: str, harness: ChaosHarness) -> dict:
                     dump, "serve_worker", n=len(dump.get("events") or [])
                 ),
                 diff_rc=diff.returncode,
+                fleet_trace=verdict["fleet_trace"],
+                fleet_trace_path=verdict["fleet_trace_path"],
+                chains_complete=verdict["chains_complete"],
+                multi_hop=verdict["multi_hop"],
             )
+            # causal incident timeline (ISSUE 19): the merged artifact must
+            # fold into an ordered story that NAMES the dead replica and
+            # places death -> failover -> revival in causal order
+            tl = subprocess.run(
+                [
+                    sys.executable, os.path.join(_HERE, "timeline.py"),
+                    "render", verdict["fleet_trace_path"], "--json",
+                ],
+                capture_output=True, text=True,
+            )
+            try:
+                entries = json.loads(tl.stdout or "[]")
+            except json.JSONDecodeError:
+                entries = []
+            kinds_in_order = [e.get("kind") for e in entries]
+            sources = {e.get("source") for e in entries}
+            causal_story = (
+                tl.returncode == 0
+                and replica in sources
+                and {"fleet_replica_down", "fleet_failover",
+                     "fleet_replica_revived"} <= set(kinds_in_order)
+                and kinds_in_order.index("fleet_failover")
+                < (len(kinds_in_order) - 1
+                   - kinds_in_order[::-1].index("fleet_replica_revived"))
+            )
+            out.update(timeline_rc=tl.returncode, causal_story=causal_story)
             out["ok"] = (
                 verdict["fires"] >= 1
                 and verdict["lost"] == 0
@@ -649,6 +707,8 @@ def audit_preset(name: str, harness: ChaosHarness) -> dict:
                 and replica.startswith("r")  # router-stamped replica name
                 and out["tail_names_site"]
                 and diff.returncode == 0
+                and verdict["chains_complete"]
+                and causal_story
             )
             out["fires"] = verdict["fires"]
         else:  # pragma: no cover - registry and drivers move together

@@ -32,6 +32,17 @@ Since ISSUE 18 two fleet-facing modes exist, both opt-in:
     schedule, same parity checks (the router duck-types the service
     surface), plus a ``routed`` per-replica split in the summary.
 
+Since ISSUE 19 fleet runs are traced end to end: every completed request's
+``timing["trace"]`` hop chain is audited by the ``hop_parity`` block (final
+hop's admission-relative route time + replica-measured serve latency must
+equal the client-observed fleet latency within the same 5% bound as
+``phase_parity``), the summary carries a ``fleet_trace`` retention block,
+``--trace`` on a fleet target writes the MERGED Perfetto trace (one process
+lane per replica, cross-replica flow links — obs/export.py
+``fleet_chrome_trace``), and setting ``CCTPU_FLEET_TRACE_PATH`` additionally
+writes the whole FleetRecord incident artifact for tools/timeline.py /
+tools/report.py.
+
 Arrival processes (seeded, ``random.Random`` — reproducible):
 
   * ``poisson``   — exponential inter-arrivals at ``--rate`` req/s;
@@ -355,6 +366,7 @@ def run_open_loop(
         "retries": retries,
         **_quantiles_ms(lat),
         "phase_parity": phase_parity(timings),
+        "hop_parity": hop_parity(timings),
         "metrics_parity": metrics_parity(svc, lat),
     }
     return summary
@@ -374,6 +386,37 @@ def phase_parity(timings: Sequence[dict]) -> dict:
             t.get("queue_wait_s", 0.0)
             + t.get("batch_wait_s", 0.0)
             + t.get("device_s", 0.0)
+        )
+        errs.append(abs(total - latency) / latency)
+    if not errs:
+        return {"checked": 0, "max_rel_err": None, "within_5pct": None}
+    return {
+        "checked": len(errs),
+        "max_rel_err": round(max(errs), 6),
+        "within_5pct": bool(max(errs) <= PHASE_PARITY_TOL),
+    }
+
+
+def hop_parity(timings: Sequence[dict]) -> dict:
+    """Audit the fleet hop chains (ISSUE 19 acceptance invariant): for every
+    completed request carrying a ``timing["trace"]`` block, the final hop's
+    admission-relative route time plus its replica-measured serve latency
+    must equal the client-observed fleet latency within PHASE_PARITY_TOL —
+    the same 5% bound phase_parity holds the single-service decomposition
+    to. The final hop's ``t`` is stamped from the SAME perf_counter origin
+    as ``fleet_latency_s`` (the router's admission ``t0``), so every
+    failover backoff and re-route gap is inside it by construction; a
+    violation means a hop went unrecorded or a chain closed on the wrong
+    hop. Single-service timings carry no trace block: checked == 0."""
+    errs = []
+    for t in timings:
+        tr = t.get("trace") or {}
+        hops = tr.get("hops") or ()
+        latency = tr.get("fleet_latency_s") or 0.0
+        if not hops or latency <= 0:
+            continue
+        total = float(hops[-1].get("t") or 0.0) + float(
+            hops[-1].get("serve_latency_s") or 0.0
         )
         errs.append(abs(total - latency) / latency)
     if not errs:
@@ -607,21 +650,46 @@ def main(argv: Optional[List[str]] = None) -> int:
             if routed is not None:
                 summary["routed"] = routed()
             rec = svc.run_record()
+            # fleet targets additionally snapshot the merged FleetRecord
+            # (ISSUE 19) while the router is still alive — the incident
+            # artifact every distributed-tracing consumer reads
+            fleet_rec_of = getattr(svc, "fleet_record", None)
+            frec = fleet_rec_of() if fleet_rec_of is not None else None
+        if frec is not None:
+            summary["fleet_trace"] = frec.summary()
+            fleet_path = os.environ.get("CCTPU_FLEET_TRACE_PATH") or None
+            if fleet_path:
+                summary["fleet_record"] = frec.write(fleet_path)
         if args.record:
             rec.write(args.record)
             summary["record"] = args.record
         if args.trace:
-            rec.to_chrome_trace(args.trace)
+            if frec is not None:
+                frec.to_chrome_trace(args.trace)
+            else:
+                rec.to_chrome_trace(args.trace)
             with open(args.trace) as f:
                 events = json.load(f).get("traceEvents", [])
             summary["trace"] = {
                 "path": args.trace,
-                "flow_links": sum(1 for e in events if e.get("ph") == "s"),
+                "flow_links": sum(
+                    1 for e in events
+                    if e.get("ph") == "s" and e.get("cat") != "fleet"
+                ),
                 "batch_spans": sum(
                     1 for e in events
                     if e.get("ph") == "X" and e.get("name") == "serve_batch"
                 ),
             }
+            if frec is not None:
+                summary["trace"]["fleet_flow_links"] = sum(
+                    1 for e in events
+                    if e.get("ph") == "s" and e.get("cat") == "fleet"
+                )
+                summary["trace"]["lanes"] = sum(
+                    1 for e in events
+                    if e.get("ph") == "M" and e.get("name") == "process_name"
+                )
     summary["process"] = args.process
     summary["seed"] = args.seed
     summary["sizes"] = args.sizes
@@ -652,6 +720,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     pp = summary["phase_parity"]
     print(f"phase parity: {pp['checked']} checked, "
           f"max_rel_err={pp['max_rel_err']} within_5pct={pp['within_5pct']}")
+    hp = summary.get("hop_parity") or {}
+    if hp.get("checked"):
+        print(f"hop parity: {hp['checked']} checked, "
+              f"max_rel_err={hp['max_rel_err']} "
+              f"within_5pct={hp['within_5pct']}")
+    ft = summary.get("fleet_trace")
+    if ft:
+        print(f"fleet trace: {ft['traces']} chains over {ft['replicas']} "
+              f"replica lanes ({ft['retired']} retired), "
+              f"{ft['multi_hop']} multi-hop, {ft['dropped']} dropped")
     mp = summary["metrics_parity"]
     print(f"/metrics parity: p50 {mp['p50_client_ms']} vs "
           f"{mp['p50_metrics_ms']} ms, p99 {mp['p99_client_ms']} vs "

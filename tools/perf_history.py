@@ -157,10 +157,25 @@ def program_bytes_of(payload: dict) -> Optional[dict]:
 def fleet_of(payload: dict) -> Optional[dict]:
     """The fleet rung's top-level keys (schema v10+, ISSUE 18), or None
     when the round predates the fleet layer (or its rung failed and only
-    the zero shape landed — an empty-steps rung still carries the keys)."""
-    keys = ("fleet_p99_ms", "fleet_rejection_rate", "fleet_swap_compiles")
+    the zero shape landed — an empty-steps rung still carries the keys).
+    Schema v11 rounds additionally carry the merged-trace accounting block
+    (``fleet_trace``, ISSUE 19)."""
+    keys = (
+        "fleet_p99_ms", "fleet_rejection_rate", "fleet_swap_compiles",
+        "fleet_trace",
+    )
     out = {k: payload[k] for k in keys if k in payload}
     return out or None
+
+
+def fleet_trace_cell(payload: dict) -> Optional[str]:
+    """The trend-table fleet-trace cell: ``traced/multi-hop`` request
+    counts from the round's merged FleetRecord summary (schema v11+), or
+    None when the round predates fleet tracing / the block is empty."""
+    ft = payload.get("fleet_trace")
+    if not isinstance(ft, dict) or "traces" not in ft:
+        return None
+    return f"{ft.get('traces', 0)}/{ft.get('multi_hop', 0)}"
 
 
 def _silent_shift_note(prev: dict, cur: dict) -> Optional[str]:
@@ -287,7 +302,8 @@ def trend_table(rows: List[dict]) -> str:
     annotate(rows)
     header = (
         f"{'round':>5} {'schema':>6} {'boots/s':>9} {'wall_s':>8} "
-        f"{'cv':>6} {'disp':>6} {'comp':>6} {'gflops':>9} {'rss_mb':>8}  note"
+        f"{'cv':>6} {'disp':>6} {'comp':>6} {'gflops':>9} {'rss_mb':>8} "
+        f"{'ftrace':>8}  note"
     )
     lines = [header, "-" * len(header)]
     for row in rows:
@@ -295,7 +311,7 @@ def trend_table(rows: List[dict]) -> str:
         if p is None:
             lines.append(
                 f"{row['round']:>5} {'-':>6} {'-':>9} {'-':>8} {'-':>6} "
-                f"{'-':>6} {'-':>6} {'-':>9} {'-':>8}  {row['note']}"
+                f"{'-':>6} {'-':>6} {'-':>9} {'-':>8} {'-':>8}  {row['note']}"
             )
             continue
         led = ledger_of(p) or {}
@@ -310,7 +326,8 @@ def trend_table(rows: List[dict]) -> str:
             f"{_fmt(led.get('device_dispatches')):>6} "
             f"{_fmt(led.get('executable_compiles')):>6} "
             f"{_fmt(flops / 1e9 if flops is not None else None, 2):>9} "
-            f"{_fmt(p.get('peak_rss_mb'), 1):>8}  "
+            f"{_fmt(p.get('peak_rss_mb'), 1):>8} "
+            f"{fleet_trace_cell(p) or '-':>8}  "
             f"{row['note']}"
         )
     return "\n".join(lines)

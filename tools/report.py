@@ -15,7 +15,8 @@ flamegraph-style text rendering of the span tree, a "== memory ==" table
 obs schema >= 4), a "== work ==" table (the deterministic per-phase work
 ledger — obs schema >= 7), an "== alerts ==" table (active SLO rules,
 raise/clear totals and the flight-recorder post-mortem path — obs schema
->= 8), error events, and the metrics snapshot
+>= 8), a "== timeline ==" section (the causally ordered incident fold from
+tools/timeline.py — obs schema >= 11), error events, and the metrics snapshot
 (bucketed histograms render p50/p99 estimates). --trace additionally
 renders the resource series as Perfetto counter tracks under the span
 lanes.
@@ -35,7 +36,7 @@ import os
 import sys
 from typing import List, Optional
 
-KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+KNOWN_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 BAR_WIDTH = 24
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -649,6 +650,42 @@ def lint(record: dict) -> str:
     )
 
 
+def _timeline_mod():
+    """tools/timeline.py loaded by path (stdlib-only, same sibling
+    contract as :func:`_export_mod`); None when the file was not copied
+    along with this script."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "timeline.py"
+    )
+    if not os.path.isfile(path):
+        return None
+    spec = importlib.util.spec_from_file_location("_cctpu_timeline", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_TIMELINE_LIMIT = 20
+
+
+def timeline(record: dict) -> str:
+    """Causal incident timeline (obs schema >= 11): the last
+    ``_TIMELINE_LIMIT`` incident entries of tools/timeline.py's fold —
+    alerts, worker restarts, replica death/failover/revival, swap and
+    control transitions — causally ordered on one clock. Quiet runs (no
+    incident-vocabulary events) render the placeholder; a missing
+    timeline.py degrades to a note, never an error."""
+    tl = _timeline_mod()
+    if tl is None:
+        return "(tools/timeline.py not found next to this script)"
+    lines = tl.render_lines(record, limit=_TIMELINE_LIMIT)
+    if lines[-1] == "(no incident entries)":
+        return "(no incident entries)"
+    return "\n".join(lines)
+
+
 def render(record: dict) -> str:
     schema = record.get("schema")
     head = (
@@ -676,6 +713,7 @@ def render(record: dict) -> str:
         "", "== memory ==", memory(record),
         "", "== numerics ==", numerics(record),
         "", "== alerts ==", alerts(record),
+        "", "== timeline ==", timeline(record),
         "", "== lint ==", lint(record),
         "", "== metrics ==", metrics_summary(record),
         "", f"events: {len(record.get('events', []))} ({len(errors)} with errors)",

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Causal incident timeline for fleet runs (ISSUE 19 tentpole, part c).
+
+Folds the merged fleet event streams — alerts raised/cleared, replica
+death/failover/revival, hot-swap phases, control actions, postmortem dumps,
+worker restarts, stall detections — into ONE causally ordered incident
+timeline: every entry rebased onto the router's clock via the per-replica
+``epoch_offset_s`` a FleetRecord carries, ties at equal (4-decimal) stamps
+broken by causal rank, not arrival order. Clock resolution on a busy host is
+coarser than causality: a replica death, the router's failover event and the
+postmortem dump land on the same rounded tick, and a timeline that orders
+them dump-before-death reads backwards in an incident review.
+
+Input is a serialized FleetRecord (obs/fleetobs.py, ``kind:
+"fleet_record"``) or a plain RunRecord JSON document — the fold only touches
+JSON-shaped dicts, and this file is stdlib-only (no package import, no jax /
+numpy) so it runs on any host an incident artifact lands on, exactly like
+tools/report.py. ``tools/report.py`` embeds :func:`render_lines` as its
+``== timeline ==`` section.
+
+Usage:
+    python tools/timeline.py render ARTIFACT.json [--limit N] [--json]
+    python tools/timeline.py diff BASELINE.json CURRENT.json
+
+Exit codes follow the tools/bench_diff.py convention: 0 clean, 1 usage /
+unreadable artifact, 3 divergence (diff mode: the two artifacts' incident
+*sequences* — (source, kind) pairs, timestamps ignored, revival generation
+numbers normalized — disagree).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# The incident vocabulary: the obs/schema.py event kinds that mark a state
+# transition an operator reasons about (requests/metrics-scrape chatter like
+# ``serve_request`` stays out — the timeline is for incidents, the Perfetto
+# export is for request-level forensics). Values are the causal tie-break
+# rank at equal rounded timestamps: cause before effect, raise before clear,
+# birth before death before failover before revival.
+CAUSAL_RANK: Dict[str, int] = {
+    "fleet_start": 0,
+    "serve_start": 5,
+    "aot_warm_start": 8,
+    "alert_raised": 10,
+    "stall_detected": 15,
+    "serve_worker_restart": 20,
+    "retries_exhausted": 25,
+    "postmortem_dump": 30,
+    "fleet_replica_down": 35,
+    "fleet_failover": 40,
+    "fleet_replica_revived": 45,
+    "serve_drain": 50,
+    "fleet_swap": 55,
+    "fleet_control": 60,
+    "alert_cleared": 65,
+    "fleet_drain": 70,
+}
+TIMELINE_KINDS = frozenset(CAUSAL_RANK)
+
+_MAX_DETAIL_CHARS = 120
+
+
+def _is_fleet(record: dict) -> bool:
+    return record.get("kind") == "fleet_record" or (
+        "router" in record and "replicas" in record
+    )
+
+
+def _sources(record: dict) -> Iterable[Tuple[str, dict, float]]:
+    """(source-name, embedded RunRecord dict, rebase-offset-seconds) per
+    lane. For a FleetRecord all offsets shift onto the earliest epoch in the
+    fleet (replicas are built before the router, so the minimum offset can
+    be negative); a plain RunRecord is one unshifted ``run`` lane."""
+    if not _is_fleet(record):
+        yield "run", record, 0.0
+        return
+    replicas = list(record.get("replicas") or ())
+    base = min(
+        [0.0] + [float(r.get("epoch_offset_s") or 0.0) for r in replicas]
+    )
+    yield "router", record.get("router") or {}, 0.0 - base
+    for i, rep in enumerate(replicas):
+        name = str(rep.get("name") or f"replica{i}")
+        yield name, rep.get("record") or {}, float(
+            rep.get("epoch_offset_s") or 0.0
+        ) - base
+
+
+def _detail(ev: dict) -> Dict[str, Any]:
+    return {
+        k: v for k, v in ev.items() if k not in ("kind", "t", "span")
+    }
+
+
+def fold(record: dict) -> List[dict]:
+    """The causally ordered incident entries for one artifact:
+    ``{"t", "source", "kind", "detail"}``, sorted by rebased timestamp with
+    :data:`CAUSAL_RANK` breaking ties (then source name, then per-source
+    stream order, so the fold is deterministic for identical inputs)."""
+    entries: List[Tuple[float, int, str, int, dict]] = []
+    for source, rec, offset in _sources(record):
+        for seq, ev in enumerate(rec.get("events") or ()):
+            kind = str(ev.get("kind"))
+            if kind not in TIMELINE_KINDS:
+                continue
+            try:
+                t = round(float(ev.get("t") or 0.0) + offset, 4)
+            except (TypeError, ValueError):
+                continue
+            entries.append((t, CAUSAL_RANK[kind], source, seq, {
+                "t": t, "source": source, "kind": kind, "detail": _detail(ev),
+            }))
+    entries.sort(key=lambda row: row[:4])
+    return [row[4] for row in entries]
+
+
+def _fmt_detail(detail: Dict[str, Any]) -> str:
+    parts = []
+    for k in sorted(detail):
+        v = detail[k]
+        if isinstance(v, float):
+            v = round(v, 4)
+        parts.append(f"{k}={v!r}" if isinstance(v, str) else f"{k}={v}")
+    text = " ".join(parts)
+    if len(text) > _MAX_DETAIL_CHARS:
+        text = text[: _MAX_DETAIL_CHARS - 3] + "..."
+    return text
+
+
+def render_lines(record: dict, limit: Optional[int] = None) -> List[str]:
+    """The human timeline: a header line, then one ``+T  source  kind
+    detail`` row per entry (optionally the last ``limit`` rows — incidents
+    cluster at the end of a run, and report embedding wants a bound)."""
+    entries = fold(record)
+    if _is_fleet(record):
+        replicas = list(record.get("replicas") or ())
+        head = (
+            f"fleet timeline: schema={record.get('schema')} "
+            f"generation={record.get('generation')} "
+            f"replicas={len(replicas)} "
+            f"({sum(1 for r in replicas if r.get('retired'))} retired) "
+            f"entries={len(entries)}"
+        )
+    else:
+        head = (
+            f"run timeline: schema={record.get('schema')} "
+            f"entries={len(entries)}"
+        )
+    lines = [head]
+    shown = entries if limit is None else entries[-max(int(limit), 0):]
+    if len(shown) < len(entries):
+        lines.append(f"... ({len(entries) - len(shown)} earlier entries)")
+    src_w = max((len(e["source"]) for e in shown), default=0)
+    for e in shown:
+        lines.append(
+            f"+{e['t']:9.4f}s  {e['source']:<{src_w}}  {e['kind']:<22}  "
+            f"{_fmt_detail(e['detail'])}".rstrip()
+        )
+    if not entries:
+        lines.append("(no incident entries)")
+    return lines
+
+
+_REVIVAL_GEN = re.compile(r"~\d+")
+
+
+def _norm(name: str) -> str:
+    """Collapse revival generation numbers (``r0~3`` -> ``r0~``): the slot
+    and the fact it was revived are causally meaningful, the global revival
+    counter value is run-dependent scheduling noise."""
+    return _REVIVAL_GEN.sub("~", name)
+
+
+def incident_signature(record: dict) -> List[Tuple[str, str]]:
+    """The comparable causal skeleton: the ordered (source, kind) sequence
+    with timestamps dropped and revival generations normalized."""
+    return [(_norm(e["source"]), e["kind"]) for e in fold(record)]
+
+
+def diff_timelines(baseline: dict, current: dict) -> Tuple[int, List[str]]:
+    """Compare two artifacts' incident signatures; (exit-code, lines).
+    Divergence (exit 3) names the first differing position — an incident
+    replay that gained, lost or reordered a causal step is a behaviour
+    change even when every wall-clock stamp moved."""
+    a = incident_signature(baseline)
+    b = incident_signature(current)
+    lines: List[str] = []
+    for i, (ea, eb) in enumerate(zip(a, b)):
+        if ea != eb:
+            lines.append(
+                f"timeline diverges at entry {i}: "
+                f"baseline {ea[0]}/{ea[1]} vs current {eb[0]}/{eb[1]}"
+            )
+            return 3, lines
+    if len(a) != len(b):
+        longer, tag = (a, "baseline") if len(a) > len(b) else (b, "current")
+        extra = longer[min(len(a), len(b))]
+        lines.append(
+            f"timeline diverges at entry {min(len(a), len(b))}: "
+            f"only {tag} continues with {extra[0]}/{extra[1]} "
+            f"({len(a)} vs {len(b)} entries)"
+        )
+        return 3, lines
+    lines.append(f"timelines match ({len(a)} entries)")
+    return 0, lines
+
+
+def load(path: str) -> dict:
+    """A FleetRecord / RunRecord JSON document; JSONL run-record streams
+    (obs/record.py ``write`` appends) fall back to their LAST record."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        rows = [line for line in text.splitlines() if line.strip()]
+        if not rows:
+            raise
+        doc = json.loads(rows[-1])
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object artifact")
+    return doc
+
+
+USAGE = (
+    "usage: python tools/timeline.py render ARTIFACT.json [--limit N] "
+    "[--json]\n"
+    "       python tools/timeline.py diff BASELINE.json CURRENT.json"
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(USAGE)
+        return 0 if args else 1
+    cmd, rest = args[0], args[1:]
+    if cmd == "render":
+        as_json = "--json" in rest
+        rest = [a for a in rest if a != "--json"]
+        limit: Optional[int] = None
+        if "--limit" in rest:
+            i = rest.index("--limit")
+            try:
+                limit = int(rest[i + 1])
+            except (IndexError, ValueError):
+                print(USAGE, file=sys.stderr)
+                return 1
+            del rest[i:i + 2]
+        if len(rest) != 1:
+            print(USAGE, file=sys.stderr)
+            return 1
+        try:
+            record = load(rest[0])
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"timeline: cannot load {rest[0]}: {e}", file=sys.stderr)
+            return 1
+        if as_json:
+            entries = fold(record)
+            print(json.dumps(
+                entries if limit is None else entries[-max(limit, 0):]
+            ))
+        else:
+            print("\n".join(render_lines(record, limit=limit)))
+        return 0
+    if cmd == "diff":
+        if len(rest) != 2:
+            print(USAGE, file=sys.stderr)
+            return 1
+        try:
+            baseline, current = load(rest[0]), load(rest[1])
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"timeline: cannot load artifact: {e}", file=sys.stderr)
+            return 1
+        rc, lines = diff_timelines(baseline, current)
+        print("\n".join(lines))
+        return rc
+    print(USAGE, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

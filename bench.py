@@ -634,6 +634,8 @@ _SPARSE_CONSENSUS_ZERO = {
     "n_clusters": 0,
     "peak_rss_mb": 0.0,
     "cocluster_rss_peak_mb": 0.0,
+    "cocluster_rss_ceiling_mb": 0.0,
+    "cocluster_rss_within_ceiling": True,
     "carry_mb": 0.0,
     "dense_equiv_mb": 0.0,
     "labels_fingerprint": None,
@@ -651,7 +653,22 @@ def _sparse_consensus_rung() -> dict:
     EXACT accumulator footprint (``carry_mb`` = n*m*8 bytes) against the
     dense equivalent (``dense_equiv_mb`` = n*n*8 bytes), and the rung's
     consensus-label fingerprint. Never raises: any failure returns the zero
-    shape with an error note."""
+    shape with an error note.
+
+    ISSUE 20 (the r18 "456.8 MB vs 2.1 MB carries" chase): the cocluster
+    watermark is the sampler's ABSOLUTE process RSS during the span, not an
+    accumulator delta — profiled in isolation, a fresh process's
+    SparseCoclusterAccumulator at this rung's shape (n=4096, m=64) adds
+    < 1 MB over the ~366 MB import/runtime floor across update(),
+    distances() and consensus_knn(); the span number is dominated by the
+    resident floor the boots phase leaves behind (retained executables +
+    cached buffers), which is why it tracks peak_rss_mb, not carry_mb.
+    Documented rather than "fixed": there is no cocluster transient to
+    kill. The watermark is pinned by ``cocluster_rss_ceiling_mb``
+    (BENCH_SPARSE_RSS_CEILING_MB, default 512 on CPU smoke) —
+    ``cocluster_rss_within_ceiling`` flips false if a real transient ever
+    appears, and ``--gate sparse_rss`` still gates the raw watermark
+    relatively."""
     try:
         import jax
         import jax.numpy as jnp
@@ -700,6 +717,13 @@ def _sparse_consensus_rung() -> dict:
                     if "rss_peak_bytes" in attrs:
                         cocluster_rss = float(attrs["rss_peak_bytes"])
         m = int(m if m is not None else (res.sparse.m if res.sparse else 0))
+        # absolute-watermark ceiling (see docstring): 512 MB covers the CPU
+        # smoke floor with headroom; accelerator hosts carry bigger runtimes
+        rss_ceiling = float(
+            os.environ.get(
+                "BENCH_SPARSE_RSS_CEILING_MB", 512.0 if not on_accel else 2048.0
+            )
+        )
         return {
             "cells": n,
             "boots": nboots,
@@ -712,6 +736,10 @@ def _sparse_consensus_rung() -> dict:
             "n_clusters": int(res.n_clusters),
             "peak_rss_mb": round(rss_peak / 1e6, 1),
             "cocluster_rss_peak_mb": round(cocluster_rss / 1e6, 1),
+            "cocluster_rss_ceiling_mb": rss_ceiling,
+            "cocluster_rss_within_ceiling": bool(
+                cocluster_rss / 1e6 <= rss_ceiling
+            ),
             # deterministic memory model: the restricted carries are exactly
             # 2 x [n, m] f32; the dense regime's would be 2 x [n, n]
             "carry_mb": round(n * m * 8 / 1e6, 2),
@@ -1274,8 +1302,14 @@ def _run() -> dict:
     ).astype(np.float32)
 
     res_range = tuple(float(r) for r in np.linspace(0.05, 1.5, n_res))
+    # boots_per_program=2 (ISSUE 20): scan chunk/2 groups of a width-2 vmap
+    # inside the one boot dispatch — ~4x less _boot_batch est_bytes at
+    # bit-identical labels (tests/test_byte_diet.py); BENCH_BPP overrides,
+    # 0 restores the historical one-vmap-per-chunk program.
+    bpp = int(os.environ.get("BENCH_BPP", 2))
     cfg = ClusterConfig(
-        nboots=nboots, res_range=res_range, k_num=(10, 15, 20), max_clusters=64
+        nboots=nboots, res_range=res_range, k_num=(10, 15, 20),
+        max_clusters=64, boots_per_program=bpp,
     )
     key = root_key(123)
     pca_dev = jnp.asarray(pca)

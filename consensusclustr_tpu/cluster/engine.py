@@ -109,30 +109,41 @@ def community_detect(
     cluster_fun: str = "leiden",
     n_iters: int = DEFAULT_COMMUNITY_ITERS,
     update_frac: float = 0.5,
+    leiden_impl: str = "jax",
 ) -> jax.Array:
     """Dispatch to the selected community-detection kernel. The reference
     switches igraph::cluster_leiden vs cluster_louvain through bluster's
-    SNNGraphParam(cluster.fun=...) (R/consensusClust.R:656)."""
+    SNNGraphParam(cluster.fun=...) (R/consensusClust.R:656). ``leiden_impl``
+    (static) selects the local-move k_ic backend for BOTH kernels — see
+    ``resolve_leiden_impl``."""
     if cluster_fun == "louvain":
-        return louvain_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
-    return leiden_fixed(kk, graph, res, n_iters=n_iters, update_frac=update_frac)
+        return louvain_fixed(
+            kk, graph, res, n_iters=n_iters, update_frac=update_frac,
+            leiden_impl=leiden_impl,
+        )
+    return leiden_fixed(
+        kk, graph, res, n_iters=n_iters, update_frac=update_frac,
+        leiden_impl=leiden_impl,
+    )
 
 
 def _grid_one_k(
     key, x, idx_max, res_list, ki, kv, min_size, max_clusters, n_iters,
-    update_frac, cluster_fun, snn_impl="jax",
+    update_frac, cluster_fun, snn_impl="jax", leiden_impl="jax",
 ):
     """One k of the candidate grid: masked SNN build + Leiden/Louvain vmapped
     over the resolution axis. ``ki``/``kv`` may be traced (the fused grid
     vmaps this over the k axis) or concrete (the looped parity oracle).
-    ``snn_impl`` is static — see ``resolve_snn_impl``."""
+    ``snn_impl``/``leiden_impl`` are static — see ``resolve_snn_impl`` /
+    ``resolve_leiden_impl``."""
     r = res_list.shape[0]
     graph = snn_graph(idx_max, k=kv, snn_impl=snn_impl)
     keys = jax.vmap(lambda t: cluster_key(key, ki * 10_000 + t))(jnp.arange(r, dtype=jnp.int32))
 
     def one_res(kk, res):
         raw = community_detect(
-            kk, graph, res, cluster_fun, n_iters=n_iters, update_frac=update_frac
+            kk, graph, res, cluster_fun, n_iters=n_iters,
+            update_frac=update_frac, leiden_impl=leiden_impl,
         )
         compact, n_c, overflow = compact_labels(raw, max_clusters)
         score = candidate_score(x, compact, n_c, overflow, min_size, max_clusters)
@@ -145,7 +156,7 @@ def _grid_one_k(
     jax.jit,  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
     static_argnames=(
         "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
-        "compute_dtype", "snn_impl",
+        "compute_dtype", "snn_impl", "leiden_impl",
     ),
 )
 def cluster_grid(
@@ -160,6 +171,7 @@ def cluster_grid(
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
     snn_impl: str = "jax",
+    leiden_impl: str = "jax",
 ) -> GridResult:
     """All (k, resolution) candidates for one [m, d] point set, as ONE fused
     program over the full [K, R] grid.
@@ -190,6 +202,7 @@ def cluster_grid(
         lambda ki, kv: _grid_one_k(
             key, x, idx_max, res_list, ki, kv, min_size, max_clusters,
             n_iters, update_frac, cluster_fun, snn_impl=snn_impl,
+            leiden_impl=leiden_impl,
         )
     )(jnp.arange(n_k, dtype=jnp.int32), jnp.asarray(k_list, jnp.int32))
 
@@ -206,7 +219,7 @@ def cluster_grid(
     jax.jit,  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
     static_argnames=(
         "k_list", "max_clusters", "n_iters", "update_frac", "cluster_fun",
-        "compute_dtype", "snn_impl",
+        "compute_dtype", "snn_impl", "leiden_impl",
     ),
 )
 def cluster_grid_looped(
@@ -221,6 +234,7 @@ def cluster_grid_looped(
     cluster_fun: str = "leiden",
     compute_dtype: str = "float32",
     snn_impl: str = "jax",
+    leiden_impl: str = "jax",
 ) -> GridResult:
     """Parity oracle for the fused ``cluster_grid``: the historical per-k
     Python loop (one SNN build + one vmapped res sweep per k, concatenated),
@@ -236,7 +250,7 @@ def cluster_grid_looped(
         labels_k, nc_k, scores_k = _grid_one_k(
             key, x, idx_max, res_list, ki, jnp.int32(k), min_size,
             max_clusters, n_iters, update_frac, cluster_fun,
-            snn_impl=snn_impl,
+            snn_impl=snn_impl, leiden_impl=leiden_impl,
         )
         all_labels.append(labels_k)
         all_nc.append(nc_k)
@@ -328,6 +342,69 @@ def resolve_snn_impl(value: Optional[str] = None) -> str:
     if v == "pallas" and os.environ.get("CCTPU_NO_PALLAS"):
         return "jax"
     if v == "pallas" and not _pallas_snn_ok():
+        return "jax"
+    return v
+
+
+LEIDEN_IMPLS = ("jax", "pallas")
+
+# one-shot result of the pallas Leiden-sweep smoke probe — same shape and
+# degrade contract as _SNN_PROBE above
+_LEIDEN_PROBE: dict = {}
+
+
+def _pallas_leiden_ok() -> bool:
+    """Execute the fused Leiden k_ic kernel on a toy input
+    (block_until_ready, so lowering AND runtime failures both surface here)
+    — warn once, fall back to the jax slab scan, never crash the
+    pipeline."""
+    if "ok" not in _LEIDEN_PROBE:
+        try:
+            from consensusclustr_tpu.ops.pallas_leiden import (
+                pallas_leiden_kic,
+            )
+
+            out = pallas_leiden_kic(
+                jnp.zeros((8, 4), jnp.int32),
+                jnp.zeros((8, 4), jnp.int16),
+                jnp.zeros((8,), jnp.int32),
+            )
+            jax.block_until_ready(out)
+            _LEIDEN_PROBE["ok"] = True
+        except Exception as e:  # pragma: no cover - backend-specific
+            import warnings
+
+            warnings.warn(
+                "pallas Leiden kernel failed its smoke probe — falling back "
+                f"to the jax slab scan ({type(e).__name__}: {e})",
+                RuntimeWarning,
+            )
+            _LEIDEN_PROBE["ok"] = False
+    return _LEIDEN_PROBE["ok"]
+
+
+def resolve_leiden_impl(value: Optional[str] = None) -> str:
+    """Which Leiden local-move k_ic backend ``_local_moves`` runs: "jax"
+    (the slabbed int16-compare / int32-einsum scan) or "pallas"
+    (ops/pallas_leiden.py — the VMEM-resident fused sweep, bit-identical by
+    the integer-lane contract, pinned by tools/parity_audit.py's
+    ``leiden_jax:leiden_pallas`` pair). Explicit ``value`` beats the
+    ``CCTPU_LEIDEN_IMPL`` env var beats the backend default (pallas on TPU,
+    jax elsewhere — interpret-mode pallas is a correctness path, not a perf
+    path, so CPU keeps the slab scan and its ledger baseline).
+
+    Degrade contract: ``CCTPU_NO_PALLAS`` (the cocluster kill switch) forces
+    "jax" over any request, and a pallas resolution only sticks if the
+    kernel survives a one-shot executed smoke probe — otherwise warn and
+    fall back, so a Mosaic regression costs a warning, not the run."""
+    v = (value or os.environ.get("CCTPU_LEIDEN_IMPL", "") or "").strip().lower()
+    if not v:
+        v = "pallas" if jax.default_backend() == "tpu" else "jax"
+    if v not in LEIDEN_IMPLS:
+        raise ValueError(f"leiden impl must be one of {LEIDEN_IMPLS}; got {v!r}")
+    if v == "pallas" and os.environ.get("CCTPU_NO_PALLAS"):
+        return "jax"
+    if v == "pallas" and not _pallas_leiden_ok():
         return "jax"
     return v
 

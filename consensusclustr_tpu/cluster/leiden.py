@@ -50,7 +50,7 @@ _SLAB = 8  # candidate-slot slab width for the k_ic pass (memory/VPU balance)
 DEFAULT_COMMUNITY_ITERS = 12
 
 
-@functools.partial(jax.jit, static_argnames=("n_iters", "update_frac"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
+@functools.partial(jax.jit, static_argnames=("n_iters", "update_frac", "leiden_impl"))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def _local_moves(
     key: jax.Array,
     graph: SNNGraph,
@@ -58,9 +58,17 @@ def _local_moves(
     resolution: jax.Array,
     n_iters: int,
     update_frac: float = 0.5,
+    leiden_impl: str = "jax",
 ) -> jax.Array:
-    """Masked synchronous modularity local moves from an initial labelling."""
-    nbr, w, deg, two_m = graph.nbr, graph.w, graph.deg, graph.two_m
+    """Masked synchronous modularity local moves from an initial labelling.
+
+    ``leiden_impl`` (static) selects the k_ic backend: "jax" runs the slabbed
+    int16 compare / int32 einsum scan below; "pallas" runs the fused VMEM
+    sweep kernel (ops/pallas_leiden.py) — identical int32 half-unit output by
+    construction, resolved and degraded at the call-site level by
+    cluster/engine.resolve_leiden_impl.
+    """
+    nbr, hw, deg, two_m = graph.nbr, graph.hw, graph.deg, graph.two_m
     n, e = nbr.shape
     two_m = jnp.maximum(two_m, 1e-12)
     node_ids = jnp.arange(n, dtype=jnp.int32)
@@ -74,6 +82,12 @@ def _local_moves(
         + nbr[0, 0] * 0
         + jnp.asarray(jax.random.key_data(key).ravel()[0], jnp.int32) * 0
     )
+    if leiden_impl == "pallas":
+        from consensusclustr_tpu.ops.pallas_leiden import pallas_leiden_kic
+    elif leiden_impl != "jax":
+        raise ValueError(
+            f"unknown leiden_impl {leiden_impl!r} (want 'jax'|'pallas')"
+        )
 
     def body(carry, it_key):
         labels = carry
@@ -82,26 +96,47 @@ def _local_moves(
         cand_nbr = labels[nbr]                                   # [n, e]
         # candidates: neighbour communities + own community + own node id (solo)
         cand = jnp.concatenate([cand_nbr, labels[:, None], node_ids[:, None]], axis=1)
-        # k_{i->c}: weight from i into each candidate community, as a
-        # masked-equality contraction k_nbr[i,j] = sum_s w[i,s]*[cand[i,s]==
-        # cand[i,j]] — elementwise compare + reduce is the shape the VPU eats.
-        # The slot axis is processed in slabs of `slab` so the transient is
-        # [n, slab, e], never [n, e, e] (the [n, e, e+2] one-hot was the
-        # 50k-cell memory wall, VERDICT r2 weak #4; a sort+searchsorted
-        # run-total stayed [n, e] but lowered ~12x slower on TPU).
-        cpad = jnp.concatenate(
-            [cand_nbr, jnp.full((n, e_pad - e), -1, cand_nbr.dtype)], axis=1
-        ).reshape(n, e_pad // slab, slab)
+        # k_{i->c}: HALF-weight from i into each candidate community, as a
+        # masked-equality contraction k_ic_h[i,j] = sum_s hw[i,s]*[cand[i,s]
+        # == cand[i,j]] — elementwise compare + reduce is the shape the VPU
+        # eats, and the whole contraction runs in the int16/int32 lane
+        # (ISSUE 20): hw is an exact small integer, per-row sums are < 2^24
+        # half-units, so widening the int32 result once reproduces the old
+        # f32 einsum-of-halves bit for bit at half the slot-tensor bytes.
+        if leiden_impl == "pallas":
+            k_ic_h = pallas_leiden_kic(cand_nbr, hw, labels)     # [n, e+2]
+        else:
+            # The slot axis is processed in slabs of `slab` so the transient
+            # is [n, slab, e], never [n, e, e] (the [n, e, e+2] one-hot was
+            # the 50k-cell memory wall, VERDICT r2 weak #4; a
+            # sort+searchsorted run-total stayed [n, e] but lowered ~12x
+            # slower on TPU).
+            cpad = jnp.concatenate(
+                [cand_nbr, jnp.full((n, e_pad - e), -1, cand_nbr.dtype)], axis=1
+            ).reshape(n, e_pad // slab, slab)
 
-        def slab_body(_, cj):  # cj: [n, slab] candidate ids
-            eq = (cj[:, :, None] == cand_nbr[:, None, :]).astype(jnp.float32)
-            return _, jnp.einsum("njs,ns->nj", eq, w)
+            def slab_body(_, cj):  # cj: [n, slab] candidate ids
+                eq = (cj[:, :, None] == cand_nbr[:, None, :]).astype(jnp.int16)
+                return _, jnp.einsum(
+                    "njs,ns->nj", eq, hw, preferred_element_type=jnp.int32
+                )
 
-        _, k_slabs = jax.lax.scan(slab_body, None, jnp.moveaxis(cpad, 1, 0))
-        k_nbr = jnp.moveaxis(k_slabs, 0, 1).reshape(n, e_pad)[:, :e]
-        own_k = jnp.sum(w * (cand_nbr == labels[:, None]), axis=1)
-        solo_k = jnp.sum(w * (cand_nbr == node_ids[:, None]), axis=1)
-        k_ic = jnp.concatenate([k_nbr, own_k[:, None], solo_k[:, None]], axis=1)
+            _, k_slabs = jax.lax.scan(slab_body, None, jnp.moveaxis(cpad, 1, 0))
+            k_nbr = jnp.moveaxis(k_slabs, 0, 1).reshape(n, e_pad)[:, :e]
+            hw32 = hw.astype(jnp.int32)
+            own_k = jnp.sum(
+                jnp.where(cand_nbr == labels[:, None], hw32, 0),
+                axis=1, dtype=jnp.int32,
+            )
+            solo_k = jnp.sum(
+                jnp.where(cand_nbr == node_ids[:, None], hw32, 0),
+                axis=1, dtype=jnp.int32,
+            )
+            k_ic_h = jnp.concatenate(
+                [k_nbr, own_k[:, None], solo_k[:, None]], axis=1
+            )
+        # the one exact widening: integer half-units -> f32 halves
+        k_ic = k_ic_h.astype(jnp.float32) * 0.5
         # Candidate community mass WITHOUT a k_comm[cand] lookup: a gather
         # whose 2-D index array is itself computed lowers ~30x slower on TPU
         # than one with constant indices, so compose through the static nbr
@@ -196,7 +231,7 @@ def _auto_kc(n: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_iters", "update_frac", "k_coarse", "merge_rounds")  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
+    jax.jit, static_argnames=("n_iters", "update_frac", "k_coarse", "merge_rounds", "leiden_impl")  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 )
 def leiden_fixed(
     key: jax.Array,
@@ -206,6 +241,7 @@ def leiden_fixed(
     update_frac: float = 0.5,
     k_coarse: int | None = None,
     merge_rounds: int = 12,
+    leiden_impl: str = "jax",
 ) -> jax.Array:
     """Full pipeline: local moves -> community merge -> refinement moves.
 
@@ -225,12 +261,13 @@ def leiden_fixed(
     # scan carry typechecks when this runs inside shard_map (scan-vma rule).
     singletons = jnp.arange(n, dtype=jnp.int32) + graph.nbr[0, 0] * 0
     labels = _local_moves(
-        k1, graph, singletons, resolution, n_iters, update_frac
+        k1, graph, singletons, resolution, n_iters, update_frac, leiden_impl
     )
     kc = min(k_coarse if k_coarse is not None else _auto_kc(n), n)
     labels = _merge_communities(labels, graph, resolution, kc, merge_rounds)
     labels = _local_moves(
-        k2, graph, labels, resolution, max(n_iters // 2, 4), update_frac
+        k2, graph, labels, resolution, max(n_iters // 2, 4), update_frac,
+        leiden_impl,
     )
     return labels
 
@@ -279,7 +316,7 @@ def _coarse_local_moves(
 
     def body(carry, it_key):
         lab = carry
-        member = (lab[None, :] == ids[:, None]).astype(jnp.float32)   # [G, K]: M[g, d]
+        member = (lab[None, :] == ids[:, None]).astype(jnp.float32)   # graftlint: noqa[GL008] [G, K] membership IS the matmul operand of the two contractions below (member @ k_deg, big_w @ member.T); K <= _KC_CAP keeps it ~16 MB
         comm_deg = member @ k_deg                                     # [G]
         w_cg = big_w @ member.T                                       # [K, G]
         own = lab[:, None] == ids[None, :]                            # [K, G]
@@ -305,7 +342,7 @@ def _coarse_local_moves(
 
 @functools.partial(
     jax.jit,  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
-    static_argnames=("n_iters", "update_frac", "k_coarse", "n_levels", "coarse_iters"),
+    static_argnames=("n_iters", "update_frac", "k_coarse", "n_levels", "coarse_iters", "leiden_impl"),
 )
 def louvain_fixed(
     key: jax.Array,
@@ -316,6 +353,7 @@ def louvain_fixed(
     k_coarse: int | None = None,
     n_levels: int = 2,
     coarse_iters: int = 16,
+    leiden_impl: str = "jax",
 ) -> jax.Array:
     """Fixed-iteration batched classic Louvain (igraph::cluster_louvain as
     reached through bluster's SNNGraphParam(cluster.fun="louvain"), reference
@@ -335,7 +373,7 @@ def louvain_fixed(
     for level in range(n_levels):
         key, k_fine, k_coarse_key = jax.random.split(key, 3)
         labels = _local_moves(
-            k_fine, graph, labels, resolution, iters, update_frac
+            k_fine, graph, labels, resolution, iters, update_frac, leiden_impl
         )
         compact, big_w, k_deg = _coarse_graph(labels, graph, kc)
         lab = _coarse_local_moves(

@@ -28,8 +28,10 @@ rationals ≤ k, so their sums are exact in f32 under any reduction order).
 Exact low-precision lanes (ISSUE 13 tentpole): the rank weight k - r/2 is a
 dyadic rational, so its HALF-weight 2*w = 2k - r is an exact small integer
 (≤ 2*k_max). The build/symmetrise/degree hot path therefore carries int16
-half-weights — halving the scan-carry and slot-tensor bandwidth — and
-converts to f32 only at the Leiden boundary (the ``SNNGraph.w`` field).
+half-weights — halving the scan-carry and slot-tensor bandwidth — and since
+ISSUE 20 the graph itself carries them too (the ``SNNGraph.hw`` field):
+Leiden accumulates community weights in int32 half-units and widens once,
+and the classic f32 view survives as the ``SNNGraph.w`` property.
 Integer-exact, not approximate: ``hw.astype(f32) * 0.5`` reproduces the old
 f32 arithmetic bit for bit (both compute the mathematically exact value; per
 row the degree is < 2^24 half-units, so the int32 row-sum * 0.5 equals the
@@ -53,12 +55,20 @@ import jax.numpy as jnp
 
 class SNNGraph(NamedTuple):
     nbr: jax.Array    # [n, 2k] int32 neighbour ids (self-id where invalid)
-    w: jax.Array      # [n, 2k] float32 edge weights (0 where invalid)
-    deg: jax.Array    # [n] weighted degree
+    hw: jax.Array     # [n, 2k] int16 HALF-weights 2*w (0 where invalid)
+    deg: jax.Array    # [n] weighted degree (f32)
     two_m: jax.Array  # scalar, total weight * 2 == deg.sum()
     rev_dropped: jax.Array  # scalar int32: reverse-edge slot collisions
     #                         (duplicate in-edges silently dropped — the
     #                         "keep one arbitrarily" approximation count)
+
+    @property
+    def w(self) -> jax.Array:
+        """[n, 2k] f32 edge weights — the exact dyadic conversion of the
+        int16 half-weight lane (ISSUE 20: the graph now CARRIES ``hw`` so
+        Leiden's community-weight accumulations can stay integer; consumers
+        that want classic f32 weights widen here, bit-identically)."""
+        return self.hw.astype(jnp.float32) * 0.5
 
 
 def _rank_sentinel(k: int) -> int:
@@ -209,11 +219,14 @@ def _assemble_graph(idx: jax.Array, hw_out: jax.Array, colv) -> SNNGraph:
     hw = jnp.concatenate([hw_out, rev_hw], axis=1)            # [n, 2k] int16
     # exact f32 boundary: per-row degree < 2^24 half-units, so the int32
     # row-sum * 0.5 IS the f32 sum of the exact halves, bit for bit; two_m
-    # stays the f32 reduction over deg (identical values, identical order)
+    # stays the f32 reduction over deg (identical values, identical order).
+    # The edge weights themselves stay int16 half-units in the graph (ISSUE
+    # 20) — Leiden's per-node accumulations run in the integer lane and
+    # widen once, instead of shipping an f32 [n, 2k] tensor through every
+    # sweep iteration.
     deg = jnp.sum(hw.astype(jnp.int32), axis=1).astype(jnp.float32) * 0.5
-    w = hw.astype(jnp.float32) * 0.5
     return SNNGraph(
-        nbr=nbr, w=w, deg=deg, two_m=jnp.sum(deg), rev_dropped=rev_dropped
+        nbr=nbr, hw=hw, deg=deg, two_m=jnp.sum(deg), rev_dropped=rev_dropped
     )
 
 

@@ -134,6 +134,15 @@ class ClusterConfig:
     # checkpoint writes); results are bit-identical at any depth — the window
     # only changes when chunks are fetched, never what was dispatched.
     pipeline_depth: Optional[int] = None
+    # Inner vmap width of the _boot_batch program (ISSUE 20 byte diet):
+    # 0 < bpp < chunk (and chunk % bpp == 0) runs each chunk as a lax.scan
+    # over chunk/bpp groups of a width-bpp vmap inside ONE dispatch — the
+    # program's working set and est_bytes scale with bpp instead of chunk,
+    # per-boot labels stay bit-identical (vmap is an exact map), and chunk /
+    # checkpoint / dispatch accounting are untouched. None = the
+    # CCTPU_BOOTS_PER_PROGRAM env var; 0 (the resolved default) keeps the
+    # historical single-vmap HLO exactly.
+    boots_per_program: Optional[int] = None
     # Consensus-accumulator regime (consensus/pipeline.py, ISSUE 9):
     # None = auto — dense up to DENSE_CONSENSUS_LIMIT cells (16384;
     # CCTPU_DENSE_CONSENSUS_LIMIT overrides), the kNN-restricted
@@ -267,6 +276,11 @@ class ClusterConfig:
         if self.pipeline_depth is not None and self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1 (1 = serial); got {self.pipeline_depth}"
+            )
+        if self.boots_per_program is not None and int(self.boots_per_program) < 0:
+            raise ValueError(
+                f"boots_per_program must be >= 0 (0 = one vmap per chunk); "
+                f"got {self.boots_per_program}"
             )
         for knob in ("serve_queue_depth", "serve_max_batch"):
             v = getattr(self, knob)

@@ -131,7 +131,7 @@ def _dist_tile(
     def body(carry, chunk_labels):
         agree, union = carry
         valid = (chunk_labels >= 0).astype(jnp.bfloat16)                  # [c, n]
-        onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)
+        onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)  # graftlint: noqa[GL008] the bf16 one-hot IS the MXU matmul operand (both einsums below contract it); bounded by chunk rows per step
         onehot = onehot * valid[:, :, None]                               # [c, n, C]
         rows = jax.lax.dynamic_slice_in_dim(onehot, start, block, axis=1)
         vrows = jax.lax.dynamic_slice_in_dim(valid, start, block, axis=1)

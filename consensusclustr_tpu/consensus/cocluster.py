@@ -115,24 +115,31 @@ def _einsum_coclustering_distance(
 def _count_step(carry, chunk_labels, max_clusters: int):
     """One boot-chunk of agreement/union count accumulation (the MXU matmul
     body shared by the one-shot scan above and the donated streaming
-    accumulator below — counts are integers in f32, so any chunking of the
-    boot axis yields bit-identical totals)."""
+    accumulator below — counts are integers, so any chunking of the boot
+    axis yields bit-identical totals). Carry-dtype-agnostic: the one-shot
+    oracle scans f32 carries, the streaming accumulator uint16 (ISSUE 20
+    byte diet) — the per-chunk delta is an integer <= chunk rows, so the
+    cast into the carry dtype is exact either way."""
     agree, union = carry
     cvals = jnp.arange(max_clusters, dtype=jnp.int32)
     valid = (chunk_labels >= 0).astype(jnp.bfloat16)              # [c, n]
-    onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)
+    onehot = (chunk_labels[:, :, None] == cvals[None, None, :]).astype(jnp.bfloat16)  # graftlint: noqa[GL008] [c, n, C] one-hot IS the MXU matmul operand here (agree = onehot @ onehot^T rides the MXU); the transient is the price of the einsum recasting, bounded by chunk=32 rows
     onehot = onehot * valid[:, :, None]                            # [c, n, C]
     agree = agree + jnp.einsum(
         "cik,cjk->ij", onehot, onehot, preferred_element_type=jnp.float32
-    )
+    ).astype(agree.dtype)
     union = union + jnp.einsum(
         "ci,cj->ij", valid, valid, preferred_element_type=jnp.float32
-    )
+    ).astype(union.dtype)
     return (agree, union), None
 
 
 @jax.jit  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
 def _finalize_cocluster_distance(agree: jax.Array, union: jax.Array) -> jax.Array:
+    # widen once: integer counts < 2^24 are exact in f32, so finalize output
+    # is bit-identical whether the carries arrived f32 or uint16
+    agree = jnp.asarray(agree, jnp.float32)
+    union = jnp.asarray(union, jnp.float32)
     n = agree.shape[0]
     jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
     dist = 1.0 - jac
@@ -174,22 +181,34 @@ class CoclusterAccumulator:
     alive at once — the doubling called out in ISSUE 5). Here ``update`` is a
     ``counting_jit`` program with ``donate_argnums=(0, 1)``: the agree/union
     count matrices are donated back to the executable every chunk and updated
-    in place, so peak accumulator footprint stays 2 x [n, n] f32 for the whole
-    bootstrap phase, and the update dispatch rides the async stream (the chunk
+    in place, and the update dispatch rides the async stream (the chunk
     pipeline feeds device label batches straight in — no host round trip).
 
+    Carries are **uint16** (ISSUE 20 byte diet): each count is at most the
+    number of label rows folded in, so with ``rows <= 65535`` the narrow
+    lane is exact — ``update`` enforces the headroom, and ``carries()``
+    widens back to the historical f32 integer counts once at read time, so
+    the ``cocluster`` numeric-checkpoint fingerprint and every downstream
+    consumer see bit-identical values while the live footprint halves
+    (2 x [n, n] at 2 bytes/cell instead of 4).
+
     ``distance()`` renders exactly ``coclustering_distance``'s einsum result:
-    the counts are integers in f32, so accumulation order cannot change them,
+    the counts are integers, so accumulation order cannot change them,
     and the finalize formula is shared — bit-identical by construction,
     pinned in tests/test_consensus.py.
     """
+
+    # uint16 carry ceiling: counts <= rows folded in, so rows above this
+    # would saturate. nboots (x grid candidates in granular mode) at any
+    # sane setting sits orders of magnitude below it.
+    CARRY_MAX_ROWS = 65535
 
     def __init__(self, n: int, max_clusters: int = 64, chunk: int = 32):
         self.n = int(n)
         self.max_clusters = int(max_clusters)
         self._update = _make_accum_update(int(chunk))
-        self._agree = jnp.zeros((n, n), jnp.float32)
-        self._union = jnp.zeros((n, n), jnp.float32)
+        self._agree = jnp.zeros((n, n), jnp.uint16)
+        self._union = jnp.zeros((n, n), jnp.uint16)
         self.chunks = 0
         self.rows = 0
 
@@ -202,6 +221,12 @@ class CoclusterAccumulator:
             raise ValueError(
                 f"label batch shape {labels.shape} incompatible with n={self.n}"
             )
+        if self.rows + int(labels.shape[0]) > self.CARRY_MAX_ROWS:
+            raise ValueError(
+                f"uint16 co-cluster carries saturate above "
+                f"{self.CARRY_MAX_ROWS} accumulated label rows; got "
+                f"{self.rows} + {int(labels.shape[0])}"
+            )
         self._agree, self._union = self._update(
             self._agree, self._union, labels, max_clusters=self.max_clusters
         )
@@ -209,11 +234,15 @@ class CoclusterAccumulator:
         self.rows += int(labels.shape[0])
 
     def carries(self) -> tuple:
-        """The live (agree, union) count carries — the arrays the numerics
-        layer fingerprints at the ``cocluster`` checkpoint (integer counts in
-        f32, so the fingerprint is chunk-order invariant by construction).
-        Read-only view: donating callers must not mutate these."""
-        return self._agree, self._union
+        """The (agree, union) count carries, widened once to the historical
+        f32 integer counts — the arrays the numerics layer fingerprints at
+        the ``cocluster`` checkpoint (integer counts, so the fingerprint is
+        chunk-order invariant by construction and unchanged by the uint16
+        internal lane)."""
+        return (
+            self._agree.astype(jnp.float32),
+            self._union.astype(jnp.float32),
+        )
 
     def distance(self) -> jax.Array:
         """[n, n] co-clustering distance of everything folded in so far."""
@@ -245,15 +274,17 @@ def _make_sparse_accum_update(chunk: int):
             # One boot row: gather each cell's candidate-neighbour labels and
             # count agree/union ONLY on those pairs — the [n, m] transient is
             # the whole working set (no [n, n], no one-hot). Padded all--1
-            # rows contribute nothing (vv is false everywhere).
+            # rows contribute nothing (vv is false everywhere). The 0/1
+            # increments land in the carry dtype (uint16 narrow lane,
+            # ISSUE 20) — integer-exact by construction.
             agree, union = carry
             valid = row >= 0                                     # [n]
             nbr = row[cand_idx]                                  # [n, m]
             vv = valid[:, None] & (nbr >= 0)
             agree = agree + jnp.where(
-                vv & (row[:, None] == nbr), 1.0, 0.0
-            ).astype(jnp.float32)
-            union = union + jnp.where(vv, 1.0, 0.0).astype(jnp.float32)
+                vv & (row[:, None] == nbr), 1, 0
+            ).astype(agree.dtype)
+            union = union + jnp.where(vv, 1, 0).astype(union.dtype)
             return (agree, union), None
 
         (agree, union), _ = jax.lax.scan(step, (agree, union), labels)
@@ -266,7 +297,10 @@ def _make_sparse_accum_update(chunk: int):
 def _finalize_sparse_distance(agree: jax.Array, union: jax.Array) -> jax.Array:
     """[n, m] restricted co-clustering distance — the same finalize formula
     as the dense path (union 0 -> distance 1); the diagonal repair is moot
-    because candidate sets exclude self."""
+    because candidate sets exclude self. Widens the uint16 carries once
+    (integer counts < 2^24 are exact in f32)."""
+    agree = jnp.asarray(agree, jnp.float32)
+    union = jnp.asarray(union, jnp.float32)
     jac = jnp.where(union > 0, agree / jnp.maximum(union, 1.0), 0.0)
     return 1.0 - jac
 
@@ -298,7 +332,10 @@ class SparseCoclusterAccumulator:
     (cluster/knn.py::knn_candidates, top-m in PC space) and carries [n, m]
     agree/union counts instead: O(n·m) memory and FLOPs end to end, donated
     in place per chunk exactly like the dense carries, fed from the same
-    ChunkPipeline ``on_enqueue`` hook.
+    ChunkPipeline ``on_enqueue`` hook. Like the dense accumulator the
+    carries are uint16 (ISSUE 20 byte diet) with the same
+    ``CARRY_MAX_ROWS`` headroom guard, and ``carries()`` widens back to the
+    historical f32 integer counts once at read time.
 
     Restriction contract (pinned by ``tools/parity_audit.py --pair
     dense:sparse_knn`` and tests/test_sparse_consensus.py): for every
@@ -309,6 +346,8 @@ class SparseCoclusterAccumulator:
     so the downstream grid skips the dense-distance -> kNN re-extraction.
     """
 
+    CARRY_MAX_ROWS = CoclusterAccumulator.CARRY_MAX_ROWS
+
     def __init__(self, cand_idx, chunk: int = 32):
         cand_idx = jnp.asarray(cand_idx, jnp.int32)
         if cand_idx.ndim != 2:
@@ -318,8 +357,8 @@ class SparseCoclusterAccumulator:
         self.n, self.m = (int(s) for s in cand_idx.shape)
         self._cand = jax.device_put(cand_idx)
         self._update = _make_sparse_accum_update(int(chunk))
-        self._agree = jnp.zeros((self.n, self.m), jnp.float32)
-        self._union = jnp.zeros((self.n, self.m), jnp.float32)
+        self._agree = jnp.zeros((self.n, self.m), jnp.uint16)
+        self._union = jnp.zeros((self.n, self.m), jnp.uint16)
         self.chunks = 0
         self.rows = 0
 
@@ -343,6 +382,12 @@ class SparseCoclusterAccumulator:
             raise ValueError(
                 f"label batch shape {labels.shape} incompatible with n={self.n}"
             )
+        if self.rows + int(labels.shape[0]) > self.CARRY_MAX_ROWS:
+            raise ValueError(
+                f"uint16 co-cluster carries saturate above "
+                f"{self.CARRY_MAX_ROWS} accumulated label rows; got "
+                f"{self.rows} + {int(labels.shape[0])}"
+            )
         self._agree, self._union = self._update(
             self._agree, self._union, labels, self._cand
         )
@@ -350,10 +395,14 @@ class SparseCoclusterAccumulator:
         self.rows += int(labels.shape[0])
 
     def carries(self) -> tuple:
-        """The live (agree, union) [n, m] carries — fingerprinted at the
-        ``cocluster`` checkpoint; integer counts in f32, so chunk-order
-        invariant exactly like the dense carries."""
-        return self._agree, self._union
+        """The (agree, union) [n, m] carries, widened once to the historical
+        f32 integer counts — fingerprinted at the ``cocluster`` checkpoint;
+        chunk-order invariant exactly like the dense carries, and unchanged
+        by the uint16 internal lane."""
+        return (
+            self._agree.astype(jnp.float32),
+            self._union.astype(jnp.float32),
+        )
 
     def distances(self) -> jax.Array:
         """[n, m] restricted co-clustering distance of everything so far."""

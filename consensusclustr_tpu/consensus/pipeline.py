@@ -35,6 +35,7 @@ from consensusclustr_tpu.cluster.engine import (
     community_detect,
     grid_fn,
     resolve_grid_impl,
+    resolve_leiden_impl,
     resolve_snn_impl,
     ties_last_argmax as _ties_last_argmax,
 )
@@ -106,6 +107,7 @@ PAIRS_ATTR = "accumulated_pairs"        # pairs the accumulator tracked
 PAIRS_RATIO_ATTR = "pairs_ratio"        # accumulated pairs / n^2
 SNN_IMPL_ATTR = "snn_impl"              # which rank-scan backend built the SNN
 SNN_REV_DROPPED_ATTR = "snn_rev_edges_dropped"  # reverse-slot collisions dropped
+LEIDEN_IMPL_ATTR = "leiden_impl"        # which k_ic backend ran the local moves
 
 
 def dense_consensus_limit() -> int:
@@ -167,6 +169,27 @@ def resolve_candidate_m(cfg: ClusterConfig, n: int, k_list) -> int:
     return max(2, min(m, n - 1))
 
 
+def resolve_boots_per_program(cfg: ClusterConfig) -> int:
+    """Inner vmap width for ``_boot_batch`` (ISSUE 20's multi-boot batched
+    programs, inverted: the knob narrows the per-program working set by
+    scanning groups of this many boots inside one dispatch).
+
+    Resolution: explicit ``cfg.boots_per_program`` wins, then the
+    CCTPU_BOOTS_PER_PROGRAM env var; 0 (the default) disables the scan
+    wrapper and keeps the historical one-vmap-per-chunk HLO exactly.
+    Bit-identical either way — vmap is an exact map — so this is a pure
+    bytes/latency trade, not a semantics knob."""
+    if cfg.boots_per_program is not None:
+        return int(cfg.boots_per_program)
+    raw = os.environ.get("CCTPU_BOOTS_PER_PROGRAM")
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return 0
+
+
 class SparseConsensus(NamedTuple):
     """The sparse regime's restricted-count state, carried on ConsensusResult
     so downstream consumers (small-cluster merge, dendrogram, serving
@@ -193,6 +216,7 @@ class ConsensusResult(NamedTuple):
     static_argnames=(
         "k_list", "n_res", "max_clusters", "n_iters", "robust", "n_cells",
         "cluster_fun", "compute_dtype", "grid_impl", "snn_impl",
+        "leiden_impl", "boots_per_program",
     ),
 )
 def _boot_batch(
@@ -211,6 +235,8 @@ def _boot_batch(
     compute_dtype: str = "float32",
     grid_impl: str = "fused",
     snn_impl: str = "jax",
+    leiden_impl: str = "jax",
+    boots_per_program: int = 0,
 ):
     """One jitted chunk of bootstraps: gather -> grid -> select -> align.
 
@@ -220,7 +246,21 @@ def _boot_batch(
     tools/parity_audit.py ``--pair fused:looped``) must not move a single
     numeric checkpoint. ``snn_impl`` routes the SNN rank scan the same way
     (jax lax.scan vs the fused pallas kernel, ``--pair snn_jax:snn_pallas``
-    — also bit-identical by contract)."""
+    — also bit-identical by contract), and ``leiden_impl`` routes the Leiden
+    local-move k_ic sweep (jax slab scan vs the VMEM-resident pallas kernel,
+    ``--pair leiden_jax:leiden_pallas``).
+
+    ``boots_per_program`` (ISSUE 20, CCTPU_BOOTS_PER_PROGRAM /
+    ClusterConfig.boots_per_program) narrows the vmapped boot axis INSIDE the
+    program: when 0 < bpp < chunk and chunk % bpp == 0, the chunk runs as a
+    lax.scan over chunk/bpp groups of a width-bpp vmap instead of one
+    width-chunk vmap. vmap is an exact map, so per-boot outputs are
+    bit-identical either way; but the program's working set — and, because
+    scan bodies are counted ONCE by the work ledger's pre-optimization byte
+    harvest, its est_bytes — scales with bpp instead of chunk. Dispatch and
+    chunk accounting are untouched: still one program per chunk, same
+    ChunkPipeline, same checkpoint layout. Default 0 keeps today's HLO
+    exactly (pure vmap, no scan wrapper)."""
 
     def one(key_b, idx_b):
         x = pca[idx_b]
@@ -228,6 +268,7 @@ def _boot_batch(
             key_b, x, res_list, k_list, min_size,
             max_clusters=max_clusters, n_iters=n_iters, cluster_fun=cluster_fun,
             compute_dtype=compute_dtype, snn_impl=snn_impl,
+            leiden_impl=leiden_impl,
         )
         if robust:
             best = _ties_last_argmax(grid.scores)
@@ -237,6 +278,19 @@ def _boot_batch(
         aligned = align_to_cells(grid.labels, idx_b, n_cells)  # [n_cand, n]
         return aligned, grid.scores
 
+    rows = keys.shape[0]
+    bpp = boots_per_program
+    if bpp and 0 < bpp < rows and rows % bpp == 0:
+        keys_g = keys.reshape(rows // bpp, bpp, *keys.shape[1:])
+        idx_g = idx.reshape(rows // bpp, bpp, *idx.shape[1:])
+
+        def group(_, kb):
+            return _, jax.vmap(one)(*kb)
+
+        _, outs = jax.lax.scan(group, None, (keys_g, idx_g))
+        return jax.tree.map(
+            lambda a: a.reshape((rows,) + a.shape[2:]), outs
+        )
     return jax.vmap(one)(keys, idx)
 
 
@@ -310,6 +364,8 @@ def run_bootstraps(
     robust = cfg.mode == "robust"
     grid_impl = resolve_grid_impl()
     snn_impl = resolve_snn_impl()
+    leiden_impl = resolve_leiden_impl()
+    bpp = resolve_boots_per_program(cfg)
     chunk = _auto_boot_chunk(
         n, m, cfg.nboots, cfg.boot_batch, len(cfg.res_range), max(k_list),
         n_k=len(k_list),
@@ -494,7 +550,7 @@ def run_bootstraps(
                         len(cfg.res_range), cfg.max_clusters,
                         DEFAULT_COMMUNITY_ITERS,
                         robust, n, cfg.cluster_fun, cfg.compute_dtype,
-                        grid_impl, snn_impl,
+                        grid_impl, snn_impl, leiden_impl, bpp,
                     ),
                     meta=(s, e),
                 )
@@ -524,6 +580,7 @@ def run_bootstraps(
 @counting_jit(
     static_argnames=(
         "k_list", "max_clusters", "n_iters", "cluster_fun", "snn_impl",
+        "leiden_impl",
     )
 )
 def _consensus_grid_from_knn(
@@ -536,6 +593,7 @@ def _consensus_grid_from_knn(
     n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
     snn_impl: str = "jax",
+    leiden_impl: str = "jax",
 ):
     """Consensus re-clustering (reference :423-441) from a precomputed kNN
     graph: SNN + Leiden per (k, resolution); rank by PCA silhouette with the
@@ -556,7 +614,10 @@ def _consensus_grid_from_knn(
         keys = jax.vmap(lambda t: cluster_key(key, 90_000 + ki * 1000 + t))(jnp.arange(r, dtype=jnp.int32))
 
         def one_res(kk, res):
-            raw = community_detect(kk, graph, res, cluster_fun, n_iters=n_iters)
+            raw = community_detect(
+                kk, graph, res, cluster_fun, n_iters=n_iters,
+                leiden_impl=leiden_impl,
+            )
             compact, n_c, overflow = compact_labels(raw, max_clusters)
             score = consensus_candidate_score(pca, compact, n_c, overflow, max_clusters)
             return compact, score
@@ -583,12 +644,13 @@ def _consensus_grid(
     n_iters: int = DEFAULT_COMMUNITY_ITERS,
     cluster_fun: str = "leiden",
     snn_impl: str = "jax",
+    leiden_impl: str = "jax",
 ):
     """Dense-matrix entry: one kNN pass at max k, then the shared grid."""
     idx, _ = knn_from_distance(dist, max(k_list))
     return _consensus_grid_from_knn(
         key, idx, pca, res_list, k_list, max_clusters, n_iters, cluster_fun,
-        snn_impl=snn_impl,
+        snn_impl=snn_impl, leiden_impl=leiden_impl,
     )
 
 
@@ -851,6 +913,7 @@ def consensus_cluster(
     # way — O(n·m) end to end; its consensus distance is born in kNN-graph
     # form, so the grid below consumes it directly.
     snn_impl = resolve_snn_impl()
+    leiden_impl = resolve_leiden_impl()
     accum = None
     cand_idx = None
     if dense and cfg.nboots > 1 and not _pallas_wanted(use_pallas, cfg.max_clusters):
@@ -896,11 +959,13 @@ def consensus_cluster(
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, dist)
                 sp.value = dist
             with maybe_span(
-                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+                log, "consensus_grid",
+                **{SNN_IMPL_ATTR: snn_impl, LEIDEN_IMPL_ATTR: leiden_impl},
             ) as sp:
                 cons_labels, cons_scores, rev_dropped = _consensus_grid(
                     key, dist, pca, res_list, k_list, cfg.max_clusters,
                     cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
+                    leiden_impl=leiden_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
                 sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})
@@ -929,11 +994,13 @@ def consensus_cluster(
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
                 sp.value = knn_idx
             with maybe_span(
-                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+                log, "consensus_grid",
+                **{SNN_IMPL_ATTR: snn_impl, LEIDEN_IMPL_ATTR: leiden_impl},
             ) as sp:
                 cons_labels, cons_scores, rev_dropped = _consensus_grid_from_knn(
                     key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
                     cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
+                    leiden_impl=leiden_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
                 sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})
@@ -963,11 +1030,13 @@ def consensus_cluster(
                 numeric_checkpoint(log, CONSENSUS_DIST_CKPT, knn_idx)
                 sp.value = knn_idx
             with maybe_span(
-                log, "consensus_grid", **{SNN_IMPL_ATTR: snn_impl}
+                log, "consensus_grid",
+                **{SNN_IMPL_ATTR: snn_impl, LEIDEN_IMPL_ATTR: leiden_impl},
             ) as sp:
                 cons_labels, cons_scores, rev_dropped = _consensus_grid_from_knn(
                     key, knn_idx, pca, res_list, k_list, cfg.max_clusters,
                     cluster_fun=cfg.cluster_fun, snn_impl=snn_impl,
+                    leiden_impl=leiden_impl,
                 )
                 sp.value = (cons_labels, cons_scores)
                 sp.set(**{SNN_REV_DROPPED_ATTR: int(rev_dropped)})

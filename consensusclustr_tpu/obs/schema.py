@@ -148,6 +148,14 @@ from __future__ import annotations
 # a NEW artifact kind ("fleet_record") that embeds RunRecords. Bench
 # payloads gain the top-level ``fleet_trace`` block (zero shape ``{}`` on
 # failure). See docs/quirks.md "Observability schema v10 → v11".
+# ISSUE 20 (byte diet) is additive — no bump: the LEIDEN_IMPLS registry,
+# the ``leiden_impl`` consensus-span attr and the CCTPU_LEIDEN_IMPL /
+# CCTPU_BOOTS_PER_PROGRAM knobs are new names with no change to any
+# existing one; the narrow-lane dtype changes (int16 SNN half-weights,
+# uint16 co-cluster carries) are invisible at the schema boundary — every
+# fingerprinted artifact widens to the historical f32 integer values first
+# (same precedent as ISSUE 13's int16 lanes). See docs/quirks.md
+# "The byte diet (ISSUE 20)".
 SCHEMA_VERSION = 11
 
 # ``LevelLog.event`` / ``Tracer.event`` kinds — the flat, append-only record
@@ -472,6 +480,8 @@ CONSENSUS_SPAN_ATTRS = frozenset({
     # ISSUE 13: SNN build provenance on the consensus_grid spans
     "snn_impl",              # which SNN_IMPLS entry built the rank weights
     "snn_rev_edges_dropped", # reverse-edge slot collisions summed over the run
+    # ISSUE 20: Leiden local-move provenance on the consensus_grid spans
+    "leiden_impl",           # which LEIDEN_IMPLS entry ran the k_ic sweep
 })
 
 # SNN rank-build implementations (ISSUE 13): the dispatch vocabulary of
@@ -483,6 +493,17 @@ CONSENSUS_SPAN_ATTRS = frozenset({
 # ops/pallas_snn.py against this set, both directions — a renamed impl is a
 # test failure, not a silently unreachable kernel.
 SNN_IMPLS = frozenset({
+    "jax",
+    "pallas",
+})
+
+# Leiden local-move k_ic implementations (ISSUE 20): the dispatch vocabulary
+# of cluster/engine.py::resolve_leiden_impl (explicit > CCTPU_LEIDEN_IMPL >
+# backend default; CCTPU_NO_PALLAS honored, one-shot smoke probe degrades to
+# "jax" on any lowering/runtime failure — the same contract as SNN_IMPLS).
+# tools/check_obs_schema.py validates the ``*_LEIDEN_IMPL`` literals in
+# ops/pallas_leiden.py against this set, both directions.
+LEIDEN_IMPLS = frozenset({
     "jax",
     "pallas",
 })
@@ -558,6 +579,10 @@ ENV_KNOBS = {
         "unset",
         "Internal bench.py handoff: parent probe verdict, re-read by the child.",
     ),
+    "CCTPU_BOOTS_PER_PROGRAM": (
+        "0",
+        "Inner vmap width of _boot_batch: scan chunk/bpp groups per dispatch; 0 = one vmap.",
+    ),
     "CCTPU_CHUNK_BYTES": (
         "6e9 on TPU, 2e9 on CPU",
         "Consensus chunk-planner memory budget in bytes.",
@@ -605,6 +630,10 @@ ENV_KNOBS = {
     "CCTPU_GRID_IMPL": (
         "fused",
         "Boot fan-out program: 'fused' (vmapped-k) or 'looped' (parity oracle).",
+    ),
+    "CCTPU_LEIDEN_IMPL": (
+        "pallas on TPU, jax elsewhere",
+        "Leiden local-move k_ic backend: 'pallas' (fused kernel) or 'jax' (slab scan).",
     ),
     "CCTPU_LOG_LEVEL": (
         "WARNING",

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the Leiden local-move k_ic sweep.
+
+The bandwidth-lean variant of cluster/leiden.py's ``_local_moves`` inner
+contraction (ISSUE 20). The XLA slab scan streams a [n, slab, e] broadcast-
+compare one-hot through HBM per slab step — the same HBM-transient class
+ops/pallas_snn.py killed in the SNN rank build — and the edge weights
+re-visit HBM on every slab of every sweep iteration. The kernel here tiles
+the row axis and computes the whole candidate axis against VMEM-resident
+tiles: per grid step it holds one [T, e] candidate-community tile and one
+[T, e] int16 half-weight tile, and every [T, slab, e] compare cube lives and
+dies in VMEM — the one-hot never touches HBM, and the edge weights are read
+once per sweep iteration instead of once per slab.
+
+Everything is integer arithmetic (ISSUE 20's narrow-lane contract): the
+output is the int32 HALF-unit k_ic — k_ic_h[i, j] = sum_s hw[i, s] *
+[cand[i, j] == cand[i, s]] for the e neighbour candidates, plus the own-
+community and solo columns — so the caller's single ``astype(f32) * 0.5``
+widening reproduces the f32 einsum-of-halves bit for bit (per-row sums are
+< 2^24 half-units). Bit-identical to the jax slab scan by construction,
+pinned by tools/parity_audit.py --pair leiden_jax:leiden_pallas.
+
+The row-tiled kernel reads no other rows: the candidate-community gather
+``labels[nbr]`` stays outside in ``_local_moves`` (a cheap composed 1-D
+gather; see docs/perf.md on the ~30x row-gather cliff), so the kernel gets a
+gather-free problem — the same hoisting contract as ops/pallas_snn.py.
+
+Off TPU the kernel runs under ``interpret=True`` (tier-1 CPU coverage);
+runtime lowering/execution failure degrades to the jax slab scan via
+cluster/engine.resolve_leiden_impl's probe — the same warn-and-fall-back
+contract as the SNN and cocluster kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_TILE = 256      # rows per grid step; the [T, slab, e] int32 compare cube
+#                     at e=40 is ~330 KB VMEM — comfortably resident
+
+_SLAB = 8           # candidate columns per compare cube (VMEM/VPU balance,
+#                     mirrors cluster/leiden._SLAB)
+
+# The leiden_impl names cluster/engine.py dispatches on
+# (obs.schema.LEIDEN_IMPLS; tools/check_obs_schema.py pins these constants
+# <-> the registry both ways)
+JAX_LEIDEN_IMPL = "jax"
+PALLAS_LEIDEN_IMPL = "pallas"
+
+
+def _interpret() -> bool:
+    """Interpret off-TPU (CPU tier-1 runs the kernel in interpret mode);
+    resolved at trace time — the backend is fixed per process."""
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(cand_ref, hw_ref, lab_ref, ids_ref, out_ref, *, e: int):
+    cand = cand_ref[...]                                      # [T, e] int32
+    hw = hw_ref[...].astype(jnp.int32)                        # [T, e]
+    lab = lab_ref[...]                                        # [T, 1]
+    ids = ids_ref[...]                                        # [T, 1]
+    cols = []
+    for j0 in range(0, e, _SLAB):                             # static unroll
+        cj = cand[:, j0:min(j0 + _SLAB, e)]                   # [T, s]
+        eq = cj[:, :, None] == cand[:, None, :]               # VMEM-only cube
+        cols.append(jnp.sum(jnp.where(eq, hw[:, None, :], 0), axis=2))
+    own = jnp.sum(jnp.where(lab == cand, hw, 0), axis=1, keepdims=True)
+    solo = jnp.sum(jnp.where(ids == cand, hw, 0), axis=1, keepdims=True)
+    out_ref[...] = jnp.concatenate(cols + [own, solo], axis=1)
+
+
+def _row_pad(n: int):
+    tile = min(ROW_TILE, -(-n // 8) * 8)                      # sublane-aligned
+    return tile, -(-n // tile) * tile
+
+
+def _cost(n: int, e: int) -> pl.CostEstimate:
+    return pl.CostEstimate(
+        flops=2 * n * e * (e + 2),                            # compare + add
+        bytes_accessed=4 * n * e + 2 * n * e + 2 * 4 * n + 4 * n * (e + 2),
+        transcendentals=0,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))  # graftlint: noqa[GL004] inner kernel traced inline from a counting_jit entry program; its own counter would double-count the work ledger
+def _kic_call(
+    cand_nbr: jax.Array, hw: jax.Array, labels: jax.Array, interpret: bool
+) -> jax.Array:
+    n, e = cand_nbr.shape
+    tile, n_pad = _row_pad(n)
+    pad = n_pad - n
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    # padded rows use distinct negative sentinels so no padded candidate can
+    # alias a real community id (their outputs are sliced away regardless)
+    cand_p = jnp.pad(cand_nbr, ((0, pad), (0, 0)), constant_values=-1)
+    hw_p = jnp.pad(hw, ((0, pad), (0, 0)))
+    lab_p = jnp.pad(labels, (0, pad), constant_values=-2)[:, None]
+    ids_p = jnp.pad(node_ids, (0, pad), constant_values=-3)[:, None]
+    out = pl.pallas_call(
+        functools.partial(_kernel, e=e),
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, e), lambda i: (i, 0)),
+            pl.BlockSpec((tile, e), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, e + 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, e + 2), jnp.int32),
+        cost_estimate=_cost(n, e),
+        interpret=interpret,
+    )(cand_p, hw_p, lab_p, ids_p)
+    return out[:n]
+
+
+def pallas_leiden_kic(
+    cand_nbr: jax.Array, hw: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """int32 half-unit k_ic [n, e+2] — the fused-kernel twin of the
+    ``_local_moves`` slab scan (e neighbour-candidate columns, then the
+    own-community and solo columns), bit-identical by construction."""
+    return _kic_call(
+        jnp.asarray(cand_nbr, jnp.int32),
+        jnp.asarray(hw, jnp.int16),
+        jnp.asarray(labels, jnp.int32),
+        _interpret(),
+    )

@@ -1,0 +1,33 @@
+"""GL008 fixture (ISSUE 20): the leiden.py::slab_body HBM transient, replayed.
+
+The shape of the bug the byte diet killed: a float broadcast-one-hot
+``(a[:, :, None] == b[:, None, :]).astype(jnp.float32)`` inside a
+``lax.scan`` body — the [n, slab, e] compare cube streams through HBM on
+every scan step, which is exactly what made ``_boot_batch`` 14.9 GB of
+``est_bytes``. The test runs GL008 on this file and asserts exit 3 naming
+the rule and the ``eq = ...`` line. The integer twin below (``slab_body_ok``)
+is the fix shape and must NOT be flagged.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def ragged_kic(cand_nbr, w, cpad):
+    def slab_body(_, cj):
+        eq = (cj[:, :, None] == cand_nbr[:, None, :]).astype(jnp.float32)
+        return _, jnp.einsum("njs,ns->nj", eq, w)
+
+    _, k_slabs = jax.lax.scan(slab_body, None, jnp.moveaxis(cpad, 1, 0))
+    return k_slabs
+
+
+def ragged_kic_ok(cand_nbr, hw, cpad):
+    def slab_body_ok(_, cj):
+        eq = (cj[:, :, None] == cand_nbr[:, None, :]).astype(jnp.int16)
+        return _, jnp.einsum(
+            "njs,ns->nj", eq, hw, preferred_element_type=jnp.int32
+        )
+
+    _, k_slabs = jax.lax.scan(slab_body_ok, None, jnp.moveaxis(cpad, 1, 0))
+    return k_slabs

@@ -1,0 +1,308 @@
+"""Byte diet for the boot batch (ISSUE 20): uint16 co-cluster carries vs an
+int64 brute-force oracle, the int32 half-unit community-weight lane vs an
+f64 oracle, the fused Pallas Leiden k_ic kernel vs the jax slab scan, and
+multi-boot batched programs (``boots_per_program``) bit-parity incl.
+checkpoint resume across a batched chunk.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consensusclustr_tpu.cluster.engine import resolve_leiden_impl
+from consensusclustr_tpu.cluster.knn import knn_points
+from consensusclustr_tpu.cluster.leiden import (
+    leiden_fixed,
+    louvain_fixed,
+)
+from consensusclustr_tpu.cluster.snn import snn_graph
+from consensusclustr_tpu.config import ClusterConfig
+from consensusclustr_tpu.consensus.cocluster import (
+    CoclusterAccumulator,
+    SparseCoclusterAccumulator,
+)
+from consensusclustr_tpu.consensus.pipeline import (
+    resolve_boots_per_program,
+    run_bootstraps,
+)
+from consensusclustr_tpu.utils.rng import root_key
+
+
+def _blob_pca(n=120, d=6, pops=4, seed=0):
+    r = np.random.default_rng(seed)
+    centers = r.normal(0.0, 6.0, size=(pops, d))
+    return (
+        centers[r.integers(0, pops, size=n)] + r.normal(0, 1.0, size=(n, d))
+    ).astype(np.float32)
+
+
+def _random_labels(b, n, max_clusters, seed, drop=0.3):
+    """[b, n] int32 bootstrap-style labels with ~drop unsampled (-1)."""
+    r = np.random.default_rng(seed)
+    lab = r.integers(0, max_clusters, size=(b, n)).astype(np.int32)
+    lab[r.random((b, n)) < drop] = -1
+    return lab
+
+
+def _oracle_counts(labels):
+    """int64 brute-force agree/union counts — no matmuls, no narrow lanes."""
+    labels = np.asarray(labels, np.int64)
+    b, n = labels.shape
+    agree = np.zeros((n, n), np.int64)
+    union = np.zeros((n, n), np.int64)
+    for row in labels:
+        sampled = row >= 0
+        both = np.logical_and(sampled[:, None], sampled[None, :])
+        union += both
+        agree += np.logical_and(both, row[:, None] == row[None, :])
+    return agree, union
+
+
+# ---------- uint16 carries vs the int64 oracle ----------
+
+
+class TestUint16CarryOracle:
+    def test_dense_carries_match_int64_oracle(self):
+        n, c = 57, 12
+        acc = CoclusterAccumulator(n, c, chunk=8)
+        batches = [
+            _random_labels(5, n, c, seed=s) for s in (1, 2, 3)
+        ]
+        for lab in batches:
+            acc.update(lab)
+        assert acc._agree.dtype == jnp.uint16
+        assert acc._union.dtype == jnp.uint16
+        agree, union = (np.asarray(a) for a in acc.carries())
+        assert agree.dtype == np.float32 and union.dtype == np.float32
+        ref_agree, ref_union = _oracle_counts(np.concatenate(batches))
+        np.testing.assert_array_equal(agree, ref_agree.astype(np.float32))
+        np.testing.assert_array_equal(union, ref_union.astype(np.float32))
+
+    def test_sparse_carries_match_int64_oracle(self):
+        n, m, c = 64, 9, 10
+        r = np.random.default_rng(7)
+        # any candidate sets work — the restriction is a pure gather
+        cand = np.argsort(r.random((n, n)), axis=1)[:, :m].astype(np.int32)
+        acc = SparseCoclusterAccumulator(cand, chunk=8)
+        batches = [_random_labels(6, n, c, seed=s) for s in (4, 5)]
+        for lab in batches:
+            acc.update(lab)
+        assert acc._agree.dtype == jnp.uint16
+        assert acc._union.dtype == jnp.uint16
+        agree, union = (np.asarray(a) for a in acc.carries())
+        ref_agree, ref_union = _oracle_counts(np.concatenate(batches))
+        np.testing.assert_array_equal(
+            agree, np.take_along_axis(ref_agree, cand.astype(np.int64), 1)
+            .astype(np.float32)
+        )
+        np.testing.assert_array_equal(
+            union, np.take_along_axis(ref_union, cand.astype(np.int64), 1)
+            .astype(np.float32)
+        )
+
+    def test_saturation_headroom_guard(self):
+        # the uint16 lane is only exact while total accumulated rows stay
+        # under the carry ceiling — the guard must fire BEFORE wraparound
+        assert CoclusterAccumulator.CARRY_MAX_ROWS == 65535
+        assert SparseCoclusterAccumulator.CARRY_MAX_ROWS == 65535
+        for acc in (
+            CoclusterAccumulator(8, 4),
+            SparseCoclusterAccumulator(np.zeros((8, 2), np.int32)),
+        ):
+            acc.rows = acc.CARRY_MAX_ROWS - 1
+            with pytest.raises(ValueError, match="saturate"):
+                acc.update(np.zeros((2, 8), np.int32))
+            # exactly at the ceiling is still fine
+            acc.rows = acc.CARRY_MAX_ROWS - 2
+            acc.update(np.zeros((2, 8), np.int32))
+
+    def test_typical_configs_sit_far_below_ceiling(self):
+        # granular mode multiplies boots by grid candidates — even a huge
+        # sweep stays orders of magnitude under the uint16 ceiling
+        cfg = ClusterConfig(nboots=1000, k_num=(10, 15, 20),
+                            res_range=(0.1, 0.5, 1.0), mode="granular")
+        rows = cfg.nboots * len(cfg.k_num) * len(cfg.res_range)
+        assert rows < CoclusterAccumulator.CARRY_MAX_ROWS
+
+
+# ---------- int32 half-unit community weights vs the f64 oracle ----------
+
+
+class TestIntLaneCommunityWeights:
+    def _graph(self, n=150, seed=3):
+        pca = _blob_pca(n=n, seed=seed)
+        idx, _ = knn_points(jnp.asarray(pca), 12)
+        return snn_graph(idx)
+
+    def test_half_weights_are_exact_small_integers(self):
+        g = self._graph()
+        hw = np.asarray(g.hw)
+        assert hw.dtype == np.int16
+        assert hw.min() >= 0
+        # w widens the half-weight lane exactly (dyadic halves)
+        np.testing.assert_array_equal(
+            np.asarray(g.w), hw.astype(np.float32) * 0.5
+        )
+
+    def test_int32_kic_bit_equals_f64_oracle(self):
+        """The _local_moves contraction k_ic[i,j] = sum_s w[i,s] *
+        [cand[i,s] == cand[i,j]] in the int16/int32 half-unit lane, then
+        widened once, must bit-equal the same contraction carried out in
+        f64 — per-row half-unit sums sit far below 2^24, so both are exact
+        and the downcast is the only rounding anywhere."""
+        g = self._graph()
+        nbr, hw = np.asarray(g.nbr), np.asarray(g.hw)
+        n, e = nbr.shape
+        r = np.random.default_rng(11)
+        labels = r.integers(0, n, size=n).astype(np.int32)
+        cand = labels[nbr]                                       # [n, e]
+        eq = cand[:, :, None] == cand[:, None, :]                # [n, e, e]
+        # the integer lane, exactly as the jax slab scan computes it
+        k_int = np.einsum(
+            "njs,ns->nj", eq.astype(np.int16), hw, dtype=np.int32
+        )
+        lane = k_int.astype(np.float32) * 0.5
+        # headroom: every row's half-unit total is < 2^24, so int32 (and
+        # the f32 widening) are exact by construction
+        assert int(hw.astype(np.int64).sum(1).max()) < 2 ** 24
+        oracle = np.einsum(
+            "njs,ns->nj", eq.astype(np.float64),
+            hw.astype(np.float64) * 0.5,
+        )
+        np.testing.assert_array_equal(lane, oracle.astype(np.float32))
+
+
+# ---------- fused Pallas Leiden sweep vs the jax slab scan ----------
+
+
+class TestPallasLeidenParity:
+    def _graph_and_labels(self, n=130, seed=5):
+        pca = _blob_pca(n=n, seed=seed)
+        idx, _ = knn_points(jnp.asarray(pca), 10)
+        g = snn_graph(idx)
+        r = np.random.default_rng(seed + 1)
+        labels = jnp.asarray(r.integers(0, n, size=n), jnp.int32)
+        return g, labels
+
+    def test_kernel_matches_slab_scan_kic(self):
+        from consensusclustr_tpu.ops.pallas_leiden import pallas_leiden_kic
+
+        g, labels = self._graph_and_labels()
+        cand_nbr = labels[g.nbr]
+        got = np.asarray(pallas_leiden_kic(cand_nbr, g.hw, labels))
+        assert got.dtype == np.int32
+        cand_np, hw = np.asarray(cand_nbr), np.asarray(g.hw)
+        n = hw.shape[0]
+        k_nbr = np.einsum(
+            "njs,ns->nj",
+            (cand_np[:, :, None] == cand_np[:, None, :]).astype(np.int16),
+            hw, dtype=np.int32,
+        )
+        own = ((cand_np == np.asarray(labels)[:, None]) * hw.astype(np.int32)).sum(1)
+        solo = ((cand_np == np.arange(n)[:, None]) * hw.astype(np.int32)).sum(1)
+        want = np.concatenate(
+            [k_nbr, own[:, None], solo[:, None]], axis=1
+        ).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("fn", [leiden_fixed, louvain_fixed])
+    def test_full_community_detect_bit_parity(self, fn):
+        g, _ = self._graph_and_labels(seed=9)
+        key = root_key(17)
+        a = fn(key, g, 0.8, leiden_impl="jax")
+        b = fn(key, g, 0.8, leiden_impl="pallas")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resolver_env_and_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("CCTPU_LEIDEN_IMPL", "pallas")
+        # the smoke probe runs (interpret=True off-TPU) and the env wins
+        assert resolve_leiden_impl() in ("pallas", "jax")
+        assert resolve_leiden_impl("jax") == "jax"
+        monkeypatch.setenv("CCTPU_NO_PALLAS", "1")
+        assert resolve_leiden_impl("pallas") == "jax"
+        monkeypatch.delenv("CCTPU_NO_PALLAS")
+        with pytest.raises(ValueError):
+            resolve_leiden_impl("mosaic")
+
+
+# ---------- multi-boot batched programs ----------
+
+
+class TestBootsPerProgram:
+    def _cfg(self, **kw):
+        base = dict(
+            nboots=8, boot_batch=4, res_range=(0.2, 0.8), k_num=(6, 10),
+            max_clusters=32,
+        )
+        base.update(kw)
+        return ClusterConfig(**base)
+
+    def test_resolver_precedence(self, monkeypatch):
+        monkeypatch.delenv("CCTPU_BOOTS_PER_PROGRAM", raising=False)
+        assert resolve_boots_per_program(self._cfg()) == 0
+        monkeypatch.setenv("CCTPU_BOOTS_PER_PROGRAM", "2")
+        assert resolve_boots_per_program(self._cfg()) == 2
+        # explicit config beats the env
+        assert resolve_boots_per_program(
+            self._cfg(boots_per_program=4)
+        ) == 4
+        monkeypatch.setenv("CCTPU_BOOTS_PER_PROGRAM", "junk")
+        assert resolve_boots_per_program(self._cfg()) == 0
+
+    def test_negative_config_is_loud(self):
+        with pytest.raises(ValueError, match="boots_per_program"):
+            ClusterConfig(boots_per_program=-1)
+
+    @pytest.mark.parametrize("bpp", [1, 2, 4])
+    def test_bit_parity_against_unbatched(self, bpp):
+        pca = jnp.asarray(_blob_pca(n=100, seed=21))
+        key = root_key(23)
+        labels_ref, nc_ref = run_bootstraps(key, pca, self._cfg())
+        labels_b, nc_b = run_bootstraps(
+            key, pca, self._cfg(boots_per_program=bpp)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(labels_ref), np.asarray(labels_b)
+        )
+        np.testing.assert_array_equal(np.asarray(nc_ref), np.asarray(nc_b))
+
+    def test_granular_mode_bit_parity(self):
+        pca = jnp.asarray(_blob_pca(n=80, seed=25))
+        key = root_key(29)
+        cfg = self._cfg(mode="granular", nboots=4, boot_batch=2)
+        labels_ref, _ = run_bootstraps(key, pca, cfg)
+        labels_b, _ = run_bootstraps(
+            key, pca, self._cfg(
+                mode="granular", nboots=4, boot_batch=2, boots_per_program=2
+            )
+        )
+        np.testing.assert_array_equal(
+            np.asarray(labels_ref), np.asarray(labels_b)
+        )
+
+    def test_checkpoint_resume_across_batched_chunk(self, tmp_path):
+        """A run checkpointed with batching on must resume bit-identically —
+        and the resumed stream must equal the unbatched reference, chunk
+        accounting unchanged (batching is INSIDE one dispatch, the
+        chunk/checkpoint layout never sees it)."""
+        pca = jnp.asarray(_blob_pca(n=90, seed=31))
+        key = root_key(37)
+        labels_ref, _ = run_bootstraps(key, pca, self._cfg())
+        cfg_b = self._cfg(
+            checkpoint_dir=str(tmp_path), boots_per_program=2
+        )
+        labels_first, _ = run_bootstraps(key, pca, cfg_b)
+        # second run: every chunk loads from the checkpoints written by the
+        # batched run
+        acc = CoclusterAccumulator(90, 32)
+        labels_resumed, _ = run_bootstraps(key, pca, cfg_b, accumulator=acc)
+        np.testing.assert_array_equal(
+            np.asarray(labels_first), np.asarray(labels_ref)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(labels_resumed), np.asarray(labels_first)
+        )
+        assert acc.rows == 8

@@ -75,6 +75,7 @@ class TestFramework:
         rules = core.all_rules()
         assert set(rules) == {
             "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+            "GL008",
         }
         catalog = core.explain()
         for code, rule in rules.items():
@@ -140,6 +141,33 @@ class TestHistoricalRegressions:
         assert f"pr10_regression.py:{want}: GL005" in p.stdout
         # exactly one GL005: the fixed variant reads impl and is clean
         assert p.stdout.count("GL005") == 1
+
+    def test_pr20_onehot_transient_trips_gl008(self):
+        path = _fixture("pr20_onehot_transient.py")
+        want = _line_of(path, "cand_nbr[:, None, :]).astype(jnp.float32)")
+        p = _cli("--no-baseline", path)
+        assert p.returncode == 3, p.stdout + p.stderr
+        assert f"pr20_onehot_transient.py:{want}: GL008" in p.stdout
+        # exactly one GL008: the int16 twin (the narrow-lane fix shape,
+        # slab_body_ok) must NOT be flagged
+        assert p.stdout.count("GL008") == 1
+        fixed = _line_of(path, ".astype(jnp.int16)")
+        assert f"pr20_onehot_transient.py:{fixed}:" not in p.stdout
+
+    def test_gl008_ignores_onehot_outside_loop_bodies(self, tmp_path):
+        # the same expression at function scope (paid once, not per scan
+        # step — blockwise.py's oh_all/oh_pad shape) is not GL008's bug
+        src = (
+            "import jax.numpy as jnp\n"
+            "def onehot_once(codes, n_clusters):\n"
+            "    return (codes[:, None] == "
+            "jnp.arange(n_clusters, dtype=jnp.int32)[None, :])"
+            ".astype(jnp.float32)\n"
+        )
+        path = tmp_path / "loopless_onehot.py"
+        path.write_text(src)
+        p = _cli("--no-baseline", "--select", "GL008", str(path))
+        assert p.returncode == 0, p.stdout + p.stderr
 
 
 class TestNoqaSemantics:

@@ -568,6 +568,7 @@ class TestToolingSurfaces:
         attrs = {
             pl.REGIME_ATTR, pl.CANDIDATE_M_ATTR, pl.PAIRS_ATTR,
             pl.PAIRS_RATIO_ATTR, pl.SNN_IMPL_ATTR, pl.SNN_REV_DROPPED_ATTR,
+            pl.LEIDEN_IMPL_ATTR,
         }
         assert attrs == set(obs_schema.CONSENSUS_SPAN_ATTRS)
         assert "candidates" in obs_schema.SPAN_NAMES
@@ -634,15 +635,30 @@ class TestBenchRung:
 
     def test_zero_shape_matches_committed_keys(self):
         """The failure rung stays key-comparable with a real rung: exact
-        key parity with the newest committed round (r12, schema v7 — the
-        sparse block gained ``work_ledger``), superset of the pre-ledger
-        r09 block."""
+        key parity with the newest committed round (r20, ISSUE 20 — the
+        sparse block gained the ``cocluster_rss_ceiling_mb`` pin), superset
+        of the pre-ledger r09 and pre-ceiling r12 blocks."""
         bench = self._bench()
         sc = self._committed()["sparse_consensus"]
         assert set(bench._SPARSE_CONSENSUS_ZERO) >= set(sc)
         doc = json.load(open(os.path.join(REPO_ROOT, "BENCH_r12.json")))
         sc12 = doc["parsed"]["sparse_consensus"]
-        assert set(bench._SPARSE_CONSENSUS_ZERO) == set(sc12)
+        assert set(bench._SPARSE_CONSENSUS_ZERO) > set(sc12)
+        doc = json.load(open(os.path.join(REPO_ROOT, "BENCH_r20.json")))
+        sc20 = doc["parsed"]["sparse_consensus"]
+        assert set(bench._SPARSE_CONSENSUS_ZERO) == set(sc20)
+
+    def test_r20_cocluster_rss_within_pinned_ceiling(self):
+        """ISSUE 20 satellite: the sparse rung's absolute cocluster-span
+        watermark sits under the pinned ceiling — the chase concluded it is
+        the process resident floor (the accumulator's own delta is < 1 MB;
+        see bench._sparse_consensus_rung's docstring), so a breach means a
+        REAL transient appeared."""
+        doc = json.load(open(os.path.join(REPO_ROOT, "BENCH_r20.json")))
+        sc = doc["parsed"]["sparse_consensus"]
+        assert sc["cocluster_rss_ceiling_mb"] > 0
+        assert sc["cocluster_rss_within_ceiling"] is True
+        assert sc["cocluster_rss_peak_mb"] <= sc["cocluster_rss_ceiling_mb"]
 
     def test_check_mode_accepts_committed_pair(self):
         """bench_diff --check over the newest committed pair (r07 schema 5 ->

@@ -30,8 +30,15 @@ Usage:
         # no direction; the lower-better registry is untouched): labels
         # either reproduce bit-for-bit or they don't. Only meaningful when
         # both payloads carry the SAME obs_schema stamp; the gate refuses
-        # (exit 1) otherwise, and a missing fingerprint on either side is a
-        # loud failure, never a silent pass.
+        # (exit 1) otherwise — EXCEPT the committed-pair modes
+        # (--check/--latest), which relax a FORWARD bump to a warning and
+        # compare anyway (ISSUE 20): the fingerprint algorithm
+        # (obs/fingerprint.py checksum over the label strings) is frozen
+        # independently of the schema's field set, and every
+        # schema-bumping PR would otherwise lose exactly the parity
+        # evidence its byte-diet gates need. Backward jumps and explicit
+        # file pairs still refuse, and a missing fingerprint on either
+        # side is a loud failure, never a silent pass.
     python tools/bench_diff.py OLD NEW --gate work            # work ledger
         # gate (obs schema v7, ISSUE 12): EXACT comparison of every
         # ``work_ledger.counters`` entry — the deterministic work counters
@@ -328,13 +335,14 @@ def split_parity_gate(specs: List[str]) -> Tuple[bool, List[str]]:
 
 
 def parity_line(
-    old: dict, new: dict, same_schema: bool
+    old: dict, new: dict, comparable: bool
 ) -> Optional[str]:
     """Human line comparing labels_fingerprint, or None when either payload
     predates the stamp (absence is normal on old artifacts) or the schemas
-    differ (fingerprints are only defined comparable within one schema)."""
+    make the fingerprints incomparable (same stamp, or a forward bump in
+    the committed-pair modes — the caller decides)."""
     fp_old, fp_new = old.get("labels_fingerprint"), new.get("labels_fingerprint")
-    if not same_schema or fp_old is None or fp_new is None:
+    if not comparable or fp_old is None or fp_new is None:
         return None
     status = "match" if fp_old == fp_new else "DRIFT"
     return f"labels_fingerprint: {status} (old={fp_old} new={fp_new})"
@@ -502,6 +510,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     old, new = load_payload(old_path), load_payload(new_path)
     s_old, s_new = schema_of(old), schema_of(new)
+    # committed-pair forward bump: the relaxation the schema fence, the
+    # parity gate, and the parity line all key on (direction, not
+    # adjacency — see the schema-fence contract above)
+    forward_pair = bool(
+        (args.check or args.latest) and 0 < s_old < s_new
+    )
     print(f"old: {old_path} (obs_schema={s_old}) -- {old.get('metric')}")
     print(f"new: {new_path} (obs_schema={s_new}) -- {new.get('metric')}")
     if s_old != s_new and not args.allow_schema_drift:
@@ -513,7 +527,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"({s_old} -> {s_new}); schema fence skipped",
                 file=sys.stderr,
             )
-        elif (args.check or args.latest) and s_new > s_old:
+        elif forward_pair:
             # committed-pair modes tolerate any FORWARD bump: the PR that
             # bumps the schema necessarily lands one cross-version pair in
             # history forever, and refusing it would force
@@ -539,7 +553,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parity_gated, numeric_gates = split_parity_gate(args.gate)
     work_factor, numeric_gates = split_work_gate(numeric_gates)
     program_gates, numeric_gates = split_program_bytes_gates(numeric_gates)
-    line = parity_line(old, new, same_schema=(s_old == s_new))
+    line = parity_line(old, new, comparable=(s_old == s_new) or forward_pair)
     if line is not None:
         print(line)
 
@@ -599,11 +613,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"gate factor {growth:g})"
             )
     if parity_gated:
-        if s_old != s_new:
+        if s_old != s_new and not forward_pair:
             raise BenchDiffError(
                 1, "--gate parity needs both payloads on the SAME obs_schema "
                    f"(got {s_old} -> {s_new}): fingerprints are not "
                    "comparable across schema bumps"
+            )
+        if s_old != s_new:
+            # forward committed pair (ISSUE 20): the fingerprint algorithm
+            # is frozen independently of the schema field set, so the gate
+            # compares across the bump rather than dropping exactly the
+            # parity evidence a schema-bumping PR needs
+            print(
+                f"bench_diff: warning: parity gate comparing across a "
+                f"forward schema bump ({s_old} -> {s_new}) in a committed "
+                "pair; the fingerprint algorithm is schema-independent",
+                file=sys.stderr,
             )
         fp_old = old.get("labels_fingerprint")
         fp_new = new.get("labels_fingerprint")

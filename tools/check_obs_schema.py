@@ -32,6 +32,7 @@ from tools.graftlint.rules.schema_registry import (  # noqa: E402,F401
     CKPT_RE,
     EVENT_RE,
     FLIGHT_RE,
+    LEIDEN_IMPL_RE,
     MAYBE_SPAN_RE,
     METRIC_RE,
     PROG_RE,
@@ -49,6 +50,7 @@ from tools.graftlint.rules.schema_registry import (  # noqa: E402,F401
     check_fault_sites,
     check_flight_alerts,
     check_help_registry,
+    check_leiden_impls,
     check_numeric_registry,
     check_program_registry,
     check_resource_attrs,

@@ -10,6 +10,7 @@ from tools.graftlint.rules import (  # noqa: F401  (imports register rules)
     env_knobs,
     jit_ledger,
     nondeterminism,
+    onehot_transient,
     resolve_unused,
     schema_registry,
     silent_except,

@@ -1,0 +1,174 @@
+"""GL008 — broadcast-one-hot HBM transients in scanned/vmapped bodies.
+
+Bug class: the ISSUE 20 byte diet's headline finding. A rank/one-hot
+expansion written as ``(a[..., None] == b[..., None, :]).astype(float...)``
+inside a ``lax.scan``/``jax.vmap`` body materialises a float compare cube
+that XLA streams through HBM on *every* step of the scan (and every lane of
+the vmap): ``cluster/leiden.py::slab_body``'s ``[n, slab, 2k]`` float
+one-hot dominated the headline rung's 14.9 GB ``est_bytes``, exactly the
+``[n, k+1, k]`` HBM-transient class PR 13 killed in the SNN rank build.
+The fixes, in preference order: keep the compare boolean and reduce it with
+``jnp.where``/integer sums (the narrow-lane form — a bool/int16 cube is
+half the bytes and XLA fuses the reduction), or move the whole sweep into a
+VMEM-resident Pallas kernel (``ops/pallas_snn.py``, ``ops/pallas_leiden.py``).
+
+Flagged: a ``.astype(<float dtype>)`` call whose receiver is an ``==``
+comparison where BOTH sides contain a ``None``-broadcast subscript
+(``x[..., None, ...]``), lexically inside a function that the same file
+passes to ``jax.lax.scan``/``jax.lax.map``/``jax.vmap``/
+``jax.lax.fori_loop``/``jax.lax.while_loop`` (directly or through
+``functools.partial``). Integer/bool targets are NOT flagged — casting the
+one-hot to int16/bool is the fix, not the bug.
+
+When is a noqa acceptable: when the float one-hot IS the matmul operand —
+an einsum/`@` contraction that rides the MXU needs a float (bf16) one-hot,
+and the transient is the price of the matmul recasting (the co-cluster
+count bodies). Say so in the reason. A one-hot that only feeds ``where``/
+``sum``/masking is never exempt — use the boolean/integer form.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tools.graftlint.core import Finding, Rule, register
+
+# dotted-call suffixes whose function-valued arguments are "loop bodies":
+# every step re-materialises the body's transients, so a float one-hot
+# inside is paid per step, not once
+LOOP_CALL_SUFFIXES = (
+    "lax.scan", "lax.map", "lax.fori_loop", "lax.while_loop",
+    "jax.vmap", "api.vmap",
+)
+FLOAT_DTYPE_NAMES = {"float16", "bfloat16", "float32", "float64", "float_"}
+
+
+def _dotted(node: ast.AST):
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_loop_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if not name:
+        return False
+    return name == "vmap" or any(
+        name == s or name.endswith("." + s) for s in LOOP_CALL_SUFFIXES
+    )
+
+
+def _body_names(tree: ast.AST) -> Set[str]:
+    """Names of functions this file hands to a loop combinator — directly
+    (``lax.scan(body, ...)``), through ``functools.partial(body, ...)``, or
+    as a ``vmap(body)`` transform target."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_loop_call(node)):
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Call):
+                fn = _dotted(arg.func) or ""
+                if fn.endswith("partial") and arg.args and isinstance(
+                    arg.args[0], ast.Name
+                ):
+                    names.add(arg.args[0].id)
+    return names
+
+
+def _has_none_broadcast(node: ast.AST) -> bool:
+    """Whether the expression contains an ``x[..., None, ...]`` subscript —
+    the broadcast half of a one-hot expansion."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Subscript):
+            continue
+        sl = sub.slice
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        for e in elts:
+            if isinstance(e, ast.Constant) and e.value is None:
+                return True
+    return False
+
+
+def _is_float_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in FLOAT_DTYPE_NAMES or node.value.startswith(
+            ("float", "bfloat")
+        )
+    name = _dotted(node)
+    if name:
+        return name.rsplit(".", 1)[-1] in FLOAT_DTYPE_NAMES
+    return False
+
+
+def _onehot_transients(fn: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _is_float_dtype(node.args[0])
+        ):
+            continue
+        recv = node.func.value
+        if not (
+            isinstance(recv, ast.Compare)
+            and len(recv.ops) == 1
+            and isinstance(recv.ops[0], ast.Eq)
+        ):
+            continue
+        if _has_none_broadcast(recv.left) and _has_none_broadcast(
+            recv.comparators[0]
+        ):
+            yield node
+
+
+@register
+class OnehotTransientRule(Rule):
+    """Float broadcast-one-hot inside a scanned/vmapped body streams HBM.
+
+    The ISSUE 20 bug class: ``(a[..., None] == b[..., None, :])
+    .astype(float...)`` inside a ``lax.scan``/``jax.vmap`` body
+    materialises a float compare cube through HBM on every loop step —
+    the pattern behind ``_boot_batch``'s 14.9 GB ``est_bytes``. Keep the
+    compare boolean and reduce with ``jnp.where``/integer sums, or fuse the
+    sweep into a VMEM-resident Pallas kernel. noqa only when the float
+    one-hot is itself the MXU matmul operand (einsum contraction) — never
+    for a one-hot that merely feeds where/sum/masking.
+    """
+
+    code = "GL008"
+    name = "onehot-hbm-transient"
+
+    def check_file(self, ctx, pf) -> Iterable[Finding]:
+        bodies = _body_names(pf.tree)
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in bodies:
+                continue
+            for call in _onehot_transients(node):
+                if call.lineno in seen:
+                    continue
+                seen.add(call.lineno)
+                out.append(Finding(
+                    "GL008", pf.rel, call.lineno,
+                    "float broadcast-one-hot `(a[...,None] == b[...,None,:])"
+                    ".astype(float)` inside a scanned/vmapped body — an HBM "
+                    "transient paid on every loop step (the ISSUE 20 "
+                    "_boot_batch byte class); keep the compare boolean and "
+                    "reduce with where/integer sums, or fuse the sweep into "
+                    "a VMEM-resident Pallas kernel",
+                ))
+        return out

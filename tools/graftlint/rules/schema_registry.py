@@ -25,6 +25,8 @@ Sub-rules (each a ``check_*`` function, all both-directions unless noted):
                    literals ast-pinned to obs.ledger
 * snn-impls      — ops/pallas_snn.py ``*_SNN_IMPL`` <-> SNN_IMPLS +
                    cluster/engine.py dispatch tuple pin
+* leiden-impls   — ops/pallas_leiden.py ``*_LEIDEN_IMPL`` <-> LEIDEN_IMPLS +
+                   cluster/engine.py dispatch tuple pin (ISSUE 20)
 * flight-alerts  — obs/alerts.py ``*_ALERT`` <-> ALERT_RULES and
                    obs/flight.py ``*_FLIGHT`` <-> FLIGHT_EVENT_KINDS;
                    cross-module consumers registered-only
@@ -77,6 +79,10 @@ WORK_RE = re.compile(r"""^([A-Z][A-Z0-9_]*_WORK)\s*=\s*["']([A-Za-z0-9_]+)["']""
 # ops/pallas_snn.py SNN-impl constants: NAME_SNN_IMPL = "literal"
 SNN_IMPL_RE = re.compile(
     r"""^([A-Z][A-Z0-9_]*_SNN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
+)
+# ops/pallas_leiden.py Leiden-impl constants: NAME_LEIDEN_IMPL = "literal"
+LEIDEN_IMPL_RE = re.compile(
+    r"""^([A-Z][A-Z0-9_]*_LEIDEN_IMPL)\s*=\s*["']([A-Za-z0-9_]+)["']"""
 )
 # obs/alerts.py alert-rule constants: NAME_ALERT = "literal"
 ALERT_RE = re.compile(
@@ -400,6 +406,34 @@ def check_snn_impls(root: str) -> List[str]:
     return errors
 
 
+def check_leiden_impls(root: str) -> List[str]:
+    """ISSUE 20: the Leiden-implementation registry, both directions.
+
+    * ops/pallas_leiden.py ``*_LEIDEN_IMPL`` literals <-> schema.LEIDEN_IMPLS
+      (complete: every registered impl must have a defining constant — the
+      dispatch vocabulary lives where the kernel does, so an unbacked
+      registry entry is an impl nothing can select);
+    * cluster/engine.py's ``LEIDEN_IMPLS`` dispatch tuple is ast-pinned to
+      the registry (set equality) — resolve_leiden_impl must accept exactly
+      the registered vocabulary. Same contract as check_snn_impls.
+    """
+    errors = _check_constant_registry(
+        root, os.path.join("consensusclustr_tpu", "ops", "pallas_leiden.py"),
+        LEIDEN_IMPL_RE, "LEIDEN_IMPLS", "leiden impl", require_complete=True,
+    )
+    engine = os.path.join(root, "consensusclustr_tpu", "cluster", "engine.py")
+    registry = getattr(schema, "LEIDEN_IMPLS", None)
+    if registry is not None and os.path.isfile(engine):
+        got = _literal_assign(engine, "LEIDEN_IMPLS")
+        if got is not None and set(got) != set(registry):
+            errors.append(
+                "consensusclustr_tpu/cluster/engine.py: LEIDEN_IMPLS drifted "
+                f"from obs.schema.LEIDEN_IMPLS (got {sorted(got)!r}, expected "
+                f"{sorted(registry)!r})"
+            )
+    return errors
+
+
 def check_flight_alerts(root: str) -> List[str]:
     """ISSUE 14: the failure-layer registries, both directions.
 
@@ -500,6 +534,7 @@ def check(root: str) -> List[str]:
         + check_fault_sites(root)
         + check_work_ledger(root)
         + check_snn_impls(root)
+        + check_leiden_impls(root)
         + check_flight_alerts(root)
         + check_program_registry(root)
     )

@@ -44,6 +44,14 @@ Pair presets (regime A : regime B):
                  integer-exactly. --inject does not apply to this pair
                  (integer counts round-trip bf16 exactly at smoke scale, so
                  a planted downgrade could never fire).
+  leiden_jax:leiden_pallas
+                 CCTPU_LEIDEN_IMPL=jax vs pallas — the slab-scan k_ic vs
+                 the VMEM-resident Pallas local-move kernel (ISSUE 20).
+                 Swept over the full regime grid: robust+granular x
+                 leiden+louvain, each variant's checkpoint stream diffed
+                 separately (the first divergent variant is named). On CPU
+                 the kernel runs interpret=True, so the pair is a real
+                 cross-impl diff everywhere.
 
 Exit codes: 0 all pairs parity-clean; 1 usage/malformed; 3 divergence (the
 first divergent checkpoint is printed per pair and carried in the JSON
@@ -75,9 +83,21 @@ PAIRS: Dict[str, Tuple[dict, dict]] = {
     # ISSUE 13: the jax scan SNN build vs the fused Pallas rank kernel.
     # Same int16 half-weight arithmetic, different schedule — must be
     # bit-identical (interpret=True off-TPU makes this runnable anywhere).
+    # Since ISSUE 20 the int16 half-weight lane runs THROUGH Leiden too
+    # (symmetrise → degree → local-move k_ic), so this pair now audits the
+    # narrow lane end to end — it is always on, not a regime toggle.
     "snn_jax:snn_pallas": (
         {"env": {"CCTPU_SNN_IMPL": "jax"}},
         {"env": {"CCTPU_SNN_IMPL": "pallas"}},
+    ),
+    # ISSUE 20: the jax slab-scan k_ic vs the VMEM-resident Pallas
+    # local-move kernel — bit-identical by construction (same int16/int32
+    # arithmetic, different schedule; interpret=True off-TPU). Swept over
+    # the full regime grid (robust+granular x leiden+louvain) by
+    # audit_leiden_variants below, not a single stream diff.
+    "leiden_jax:leiden_pallas": (
+        {"env": {"CCTPU_LEIDEN_IMPL": "jax"}},
+        {"env": {"CCTPU_LEIDEN_IMPL": "pallas"}},
     ),
     "depth1:depth4": ({"pipeline_depth": 1}, {"pipeline_depth": 4}),
     "x64:x32": ({"x64": True}, {"x64": False}),
@@ -287,10 +307,54 @@ def audit_sparse_restricted(args) -> dict:
     }
 
 
+def audit_leiden_variants(args, inject: Optional[str] = None) -> dict:
+    """The ``leiden_jax:leiden_pallas`` preset (ISSUE 20): jax slab-scan
+    k_ic vs the VMEM-resident Pallas local-move kernel, swept over the
+    full regime grid.
+
+    The kernel sits under BOTH cluster functions (louvain shares the
+    local-move sweep) and both modes checkpoint different grid layouts
+    (robust collapses the |k|*|res| axis, granular keeps it), so one
+    stream diff per (mode, cluster_fun) variant — four audited runs, the
+    first divergent variant named in the divergence record."""
+    spec_a, spec_b = PAIRS["leiden_jax:leiden_pallas"]
+    counts = smoke_counts(args.cells, args.genes, args.seed)
+    checkpoints = 0
+    for mode in ("robust", "granular"):
+        for fun in ("leiden", "louvain"):
+            variant = {"mode": mode, "cluster_fun": fun}
+            stream_a = run_regime({**spec_a, **variant}, counts, args)
+            stream_b = run_regime(
+                {**spec_b, **variant}, counts, args, inject=inject
+            )
+            checkpoints += len(stream_a)
+            div = first_divergence(stream_a, stream_b)
+            if div is not None:
+                div = dict(div, variant=f"{mode}/{fun}")
+                return {
+                    "pair": "leiden_jax:leiden_pallas",
+                    "checkpoints": checkpoints,
+                    "variants": ["robust/leiden", "robust/louvain",
+                                 "granular/leiden", "granular/louvain"],
+                    "divergence": div,
+                    "ok": False,
+                }
+    return {
+        "pair": "leiden_jax:leiden_pallas",
+        "checkpoints": checkpoints,
+        "variants": ["robust/leiden", "robust/louvain",
+                     "granular/leiden", "granular/louvain"],
+        "divergence": None,
+        "ok": True,
+    }
+
+
 def audit_pair(pair: str, args, inject: Optional[str] = None) -> dict:
     """Run both regimes of ``pair`` on the shared workload and diff."""
     if pair == "dense:sparse_knn":
         return audit_sparse_restricted(args)
+    if pair == "leiden_jax:leiden_pallas":
+        return audit_leiden_variants(args, inject=inject)
     spec_a, spec_b = PAIRS[pair]
     counts = smoke_counts(args.cells, args.genes, args.seed)
     stream_a = run_regime(spec_a, counts, args)
@@ -372,8 +436,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f" (occurrence {d['occurrence']})"
                 if d.get("occurrence") else ""
             )
+            var = f" [{d['variant']}]" if d.get("variant") else ""
             print(
-                f"{pair}: FIRST DIVERGENT CHECKPOINT: {d['checkpoint']}"
+                f"{pair}: FIRST DIVERGENT CHECKPOINT{var}: {d['checkpoint']}"
                 f"{occ} — {d['field']}: {d['a']!r} != {d['b']!r} "
                 f"(stream index {d['index']})"
             )

@@ -36,6 +36,9 @@ payloads additionally carry the fleet rung (``fleet_p99_ms`` /
 ``fleet_rejection_rate`` / ``fleet_swap_compiles``, ISSUE 18) — surfaced
 in the --json rows; cross-schema gating needs no special case because
 the v9->v10 bump rides the same-schema fence like every bump before it.
+Every ledger-bearing row also renders its aggregate bytes/FLOP ratio
+(``B/flop`` column, ISSUE 20): the inverse arithmetic intensity — the
+axis the byte diet bends, immune to wall noise by construction.
 
 --check is the gate: exit 3 when any ADJACENT same-schema pair's ledger
 regressed (a counter grew), naming the pair and the counter. Cross-schema
@@ -203,6 +206,24 @@ def _silent_shift_note(prev: dict, cur: dict) -> Optional[str]:
     )
 
 
+def bytes_per_flop(payload: dict) -> Optional[float]:
+    """Aggregate ``est_bytes / est_flops`` from the round's ledger — the
+    arithmetic-intensity inverse, the byte-diet trend axis (ISSUE 20). A
+    perf PR that strips HBM transients moves this ratio down even when
+    the walls are all host noise; a ratio creeping UP across rounds is
+    bandwidth bloat no wall gate can see. None when either counter is
+    absent or flops is zero (failed/pre-v3 rounds)."""
+    led = ledger_of(payload) or {}
+    try:
+        b = float(led["estimated_bytes_accessed"])
+        f = float(led["estimated_flops"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if f <= 0:
+        return None
+    return b / f
+
+
 def trial_cv(payload: dict) -> Optional[float]:
     wt = payload.get("wall_trials")
     if not isinstance(wt, dict) or not wt.get("trials"):
@@ -302,8 +323,8 @@ def trend_table(rows: List[dict]) -> str:
     annotate(rows)
     header = (
         f"{'round':>5} {'schema':>6} {'boots/s':>9} {'wall_s':>8} "
-        f"{'cv':>6} {'disp':>6} {'comp':>6} {'gflops':>9} {'rss_mb':>8} "
-        f"{'ftrace':>8}  note"
+        f"{'cv':>6} {'disp':>6} {'comp':>6} {'gflops':>9} {'B/flop':>7} "
+        f"{'rss_mb':>8} {'ftrace':>8}  note"
     )
     lines = [header, "-" * len(header)]
     for row in rows:
@@ -311,7 +332,8 @@ def trend_table(rows: List[dict]) -> str:
         if p is None:
             lines.append(
                 f"{row['round']:>5} {'-':>6} {'-':>9} {'-':>8} {'-':>6} "
-                f"{'-':>6} {'-':>6} {'-':>9} {'-':>8} {'-':>8}  {row['note']}"
+                f"{'-':>6} {'-':>6} {'-':>9} {'-':>7} {'-':>8} {'-':>8}  "
+                f"{row['note']}"
             )
             continue
         led = ledger_of(p) or {}
@@ -326,6 +348,7 @@ def trend_table(rows: List[dict]) -> str:
             f"{_fmt(led.get('device_dispatches')):>6} "
             f"{_fmt(led.get('executable_compiles')):>6} "
             f"{_fmt(flops / 1e9 if flops is not None else None, 2):>9} "
+            f"{_fmt(bytes_per_flop(p), 2):>7} "
             f"{_fmt(p.get('peak_rss_mb'), 1):>8} "
             f"{fleet_trace_cell(p) or '-':>8}  "
             f"{row['note']}"
@@ -386,6 +409,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "value": (r["payload"] or {}).get("value"),
                 "wall_s": (r["payload"] or {}).get("wall_s"),
                 "cv": trial_cv(r["payload"]) if r["payload"] else None,
+                "bytes_per_flop": (
+                    bytes_per_flop(r["payload"]) if r["payload"] else None
+                ),
                 "ledger": ledger_of(r["payload"]) if r["payload"] else None,
                 "program_bytes": (
                     program_bytes_of(r["payload"]) if r["payload"] else None
